@@ -1,0 +1,47 @@
+"""glint_word2vec_tpu — a TPU-native framework for very-large-vocabulary word2vec.
+
+A ground-up reimplementation of the capabilities of MGabr/glint-word2vec
+(Spark + Glint parameter servers, skip-gram with negative sampling) designed
+for TPUs: the parameter-server-sharded ``BigWord2VecMatrix`` with its
+server-side ``dotprod``/``adjust`` RPCs becomes a vocab-row-sharded embedding
+table living in TPU HBM, updated by a single jit-compiled SGNS step under
+``shard_map``; Akka push/pull RPCs become XLA collectives over ICI; the Spark
+sentence RDD becomes a vectorized host batching pipeline feeding the device.
+
+Public API (capability map to the reference, see SURVEY.md §2):
+
+- :class:`Word2Vec` — the estimator (reference: ``ServerSideGlintWord2Vec``,
+  ml/feature/ServerSideGlintWord2Vec.scala:228 and
+  mllib/feature/ServerSideGlintWord2Vec.scala:65).
+- :class:`Word2VecModel` — the fitted model: transform / find_synonyms /
+  analogy / get_vectors / to_local / save / load (reference:
+  ``ServerSideGlintWord2VecModel``, mllib:460-726, ml:319-600).
+- :class:`glint_word2vec_tpu.parallel.engine.EmbeddingEngine` — the sharded
+  matrix engine replacing the Glint parameter-server client
+  (``BigWord2VecMatrix`` ops pull / pullAverage / norms / multiply /
+  dotprod+adjust, SURVEY.md §2.2).
+- :mod:`glint_word2vec_tpu.corpus` — vocab build, subsampling, windowing,
+  unigram alias tables (reference: ``learnVocab`` and the ``doFit`` data
+  passes, mllib:258-390).
+"""
+
+from glint_word2vec_tpu.version import __version__
+
+# NOTE: "Word2Vec"/"Word2VecModel" join __all__ when models/word2vec.py lands.
+__all__ = [
+    "__version__",
+    "Word2VecParams",
+]
+
+
+def __getattr__(name):
+    # Lazy so that host-only use (corpus tooling) never imports jax.
+    if name in ("Word2Vec", "Word2VecModel"):
+        from glint_word2vec_tpu.models import word2vec
+
+        return getattr(word2vec, name)
+    if name == "Word2VecParams":
+        from glint_word2vec_tpu.utils.params import Word2VecParams
+
+        return Word2VecParams
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

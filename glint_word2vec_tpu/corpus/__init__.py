@@ -1,0 +1,28 @@
+"""Host-side corpus layer: vocabulary, subsampling, windowing, noise tables.
+
+This is the layer the reference implements as Spark RDD passes
+(mllib/feature/ServerSideGlintWord2Vec.scala:258-390) and never unit-tests
+(SURVEY.md §4). Here it is pure NumPy, fully vectorized, and fully tested.
+"""
+
+from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.corpus.alias import AliasTable, build_unigram_alias
+from glint_word2vec_tpu.corpus.batching import (
+    SkipGramBatcher,
+    chunk_sentences,
+    encode_sentences,
+    subsample_sentence,
+    window_batch,
+)
+
+__all__ = [
+    "Vocabulary",
+    "build_vocab",
+    "AliasTable",
+    "build_unigram_alias",
+    "SkipGramBatcher",
+    "chunk_sentences",
+    "encode_sentences",
+    "subsample_sentence",
+    "window_batch",
+]

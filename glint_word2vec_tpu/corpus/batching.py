@@ -1,0 +1,214 @@
+"""Sentence -> fixed-shape skip-gram minibatch pipeline (host side).
+
+Reference data path (mllib/feature/ServerSideGlintWord2Vec.scala:329-429):
+words -> vocab indices (OOV dropped, mllib:336), sentences chunked at
+``maxSentenceLength`` (mllib:341), per-iteration frequency subsampling
+(mllib:371-379), per-position shrunk context windows (mllib:381-390), then
+``sliding(batchSize)`` groups of positions fed to the parameter servers
+(mllib:417-421).
+
+The TPU restatement: every minibatch is a *static-shape* triple
+
+    centers  (B,)       int32   -- center word indices
+    contexts (B, 2W)    int32   -- padded context word indices
+    mask     (B, 2W)    float32 -- 1.0 where the context slot is real
+
+so the jit-compiled step never recompiles. Variable-length sentences,
+shrunk windows, and partial final batches all become mask, not shape.
+
+Window semantics mirror the reference exactly (documented divergences only):
+for each position ``i``, draw ``b ~ U[0, window)`` and take context positions
+``[max(0, i-b), min(i+b, len))`` excluding ``i`` (mllib:384-388) — note the
+half-open upper bound, inherited from Scala's ``until``. Offsets therefore
+span ``[-(window-1), window-2]``, so a row needs exactly ``2*window - 3``
+context lanes (``window-1`` on the left, ``window-2`` on the right);
+:func:`context_width` is the single source of truth for that shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from glint_word2vec_tpu.corpus.vocab import Vocabulary
+
+
+def context_width(window: int) -> int:
+    """Context lanes per center position.
+
+    Reachable offsets are ``-(window-1) .. -1`` and ``1 .. window-2`` (see
+    module docstring), i.e. ``2*window - 3`` lanes. ``window=1`` draws
+    ``b = 0`` always — the reference trains nothing in that configuration —
+    kept as one permanently-masked lane so device arrays are never 0-width.
+    """
+    return max(1, 2 * int(window) - 3)
+
+
+def window_offsets(window: int) -> np.ndarray:
+    """The lane -> relative-offset map matching :func:`context_width`."""
+    W = int(window)
+    if W == 1:
+        return np.array([1], dtype=np.int64)  # never valid; see context_width
+    return np.concatenate([np.arange(-(W - 1), 0), np.arange(1, W - 1)])
+
+
+def encode_sentences(
+    sentences: Iterable[Sequence[str]], vocab: Vocabulary
+) -> List[np.ndarray]:
+    """Words -> int32 index arrays, OOV dropped, empty results removed.
+
+    Reference: ``words.flatMap(bcVocabHash.value.get)`` (mllib:335-340).
+    """
+    out = []
+    for s in sentences:
+        ids = vocab.encode(s)
+        if ids.size:
+            out.append(ids)
+    return out
+
+
+def chunk_sentences(
+    sentences: Iterable[np.ndarray], max_sentence_length: int
+) -> List[np.ndarray]:
+    """Split long sentences into chunks of at most ``max_sentence_length``.
+
+    Reference: ``sentenceSplit.grouped(maxSentenceLength)`` (mllib:341-343).
+    """
+    if max_sentence_length <= 0:
+        raise ValueError("max_sentence_length must be > 0")
+    out = []
+    for ids in sentences:
+        for start in range(0, len(ids), max_sentence_length):
+            out.append(ids[start : start + max_sentence_length])
+    return out
+
+
+def subsample_sentence(
+    ids: np.ndarray, keep_prob: np.ndarray, rng: np.random.Generator
+) -> np.ndarray:
+    """Frequency subsampling with the *intended* reference formula.
+
+    Keep word ``w`` with probability ``keep_prob[w]`` (see
+    :meth:`Vocabulary.keep_probabilities`). The reference's implementation of
+    this pass is a silent no-op due to an integer-division bug (mllib:375,
+    SURVEY.md §5); this is the fixed float semantics, reseeded per (epoch,
+    partition) exactly like the reference reseeds ``k ^ idx`` (mllib:371-373)
+    — callers pass a per-epoch ``rng``.
+    """
+    if ids.size == 0:
+        return ids
+    keep = rng.random(ids.size) <= keep_prob[ids]
+    return ids[keep]
+
+
+def window_batch(
+    ids: np.ndarray, window: int, rng: np.random.Generator
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """All (center, padded-context, mask) rows for one sentence, vectorized.
+
+    For each position ``i``: ``b = rng.integers(0, window)`` and context
+    positions ``[max(0, i-b), min(i+b, len))`` minus ``i`` (mllib:384-388).
+    Returns ``centers (L,)``, ``contexts (L, C)``, ``mask (L, C)`` with
+    ``C = context_width(window)``.
+    """
+    L = int(ids.size)
+    W = int(window)
+    C = context_width(W)
+    if L == 0:
+        z = np.zeros((0, C), dtype=np.int32)
+        return np.zeros((0,), dtype=np.int32), z, np.zeros((0, C), np.float32)
+    b = rng.integers(0, W, size=L)  # [0, window)
+    offsets = window_offsets(W)  # (C,)
+    pos = np.arange(L)[:, None] + offsets[None, :]  # (L, 2W)
+    valid = (
+        (offsets[None, :] >= -b[:, None])
+        & (offsets[None, :] <= b[:, None] - 1)
+        & (pos >= 0)
+        & (pos < L)
+    )
+    contexts = ids[np.clip(pos, 0, L - 1)].astype(np.int32)
+    contexts = np.where(valid, contexts, 0)
+    return ids.astype(np.int32), contexts, valid.astype(np.float32)
+
+
+@dataclass
+class Batch:
+    """One fixed-shape skip-gram minibatch plus progress metadata."""
+
+    centers: np.ndarray  # (B,) int32
+    contexts: np.ndarray  # (B, C) int32, C = context_width(window)
+    mask: np.ndarray  # (B, C) float32
+    words_done: int  # cumulative trained-word count (drives LR anneal)
+
+
+class SkipGramBatcher:
+    """Streams fixed-shape minibatches from an encoded corpus.
+
+    One instance per training run; :meth:`epoch` performs the per-iteration
+    subsample + window passes (reference re-runs both every iteration with
+    fresh epoch-dependent seeds, mllib:367-390) and yields :class:`Batch`es of
+    exactly ``batch_size`` center positions — the final partial batch is
+    zero-padded with mask 0 rows so device shapes stay static.
+
+    ``words_done`` counts post-subsampling trained words, the quantity the
+    reference accumulates for its LR schedule (mllib:401-413).
+    """
+
+    def __init__(
+        self,
+        sentences: List[np.ndarray],
+        vocab: Vocabulary,
+        batch_size: int,
+        window: int,
+        subsample_ratio: float = 0.0,
+        seed: int = 1,
+        shuffle: bool = False,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be > 0")
+        if window <= 0:
+            raise ValueError("window must be > 0")
+        self.sentences = sentences
+        self.vocab = vocab
+        self.batch_size = int(batch_size)
+        self.window = int(window)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.keep_prob = vocab.keep_probabilities(subsample_ratio)
+        self.words_done = 0
+
+    def epoch(self, epoch_index: int) -> Iterator[Batch]:
+        """Yield every minibatch of one pass over the corpus."""
+        B, W2 = self.batch_size, context_width(self.window)
+        rng = np.random.default_rng((self.seed, epoch_index))
+        order = np.arange(len(self.sentences))
+        if self.shuffle:
+            rng.shuffle(order)
+
+        buf_c = np.zeros(B, dtype=np.int32)
+        buf_x = np.zeros((B, W2), dtype=np.int32)
+        buf_m = np.zeros((B, W2), dtype=np.float32)
+        fill = 0
+        for si in order:
+            ids = subsample_sentence(self.sentences[si], self.keep_prob, rng)
+            self.words_done += int(ids.size)
+            c, x, m = window_batch(ids, self.window, rng)
+            n = c.shape[0]
+            start = 0
+            while n - start > 0:
+                take = min(B - fill, n - start)
+                buf_c[fill : fill + take] = c[start : start + take]
+                buf_x[fill : fill + take] = x[start : start + take]
+                buf_m[fill : fill + take] = m[start : start + take]
+                fill += take
+                start += take
+                if fill == B:
+                    yield Batch(buf_c.copy(), buf_x.copy(), buf_m.copy(), self.words_done)
+                    fill = 0
+        if fill > 0:
+            buf_c[fill:] = 0
+            buf_x[fill:] = 0
+            buf_m[fill:] = 0.0
+            yield Batch(buf_c.copy(), buf_x.copy(), buf_m.copy(), self.words_done)

@@ -1,0 +1,140 @@
+"""Vocabulary construction with reference semantics.
+
+Reference behavior (mllib/feature/ServerSideGlintWord2Vec.scala:258-279,
+``learnVocab``): count words, drop those with count < min_count, sort by count
+descending, and assign each word its frequency rank as its integer index.
+``train_words_count`` is the total count of *kept* word occurrences and drives
+the learning-rate annealing schedule (mllib:405-413).
+
+The reference runs this as a Spark ``flatMap -> reduceByKey -> filter ->
+collect -> sortBy`` pipeline; here it is a single vectorized pass. Ties in
+counts are broken by first-seen order to keep the indexing deterministic for a
+given corpus ordering (Scala's ``sortBy`` is stable, giving the same property).
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class Vocabulary:
+    """Immutable result of a vocab scan.
+
+    Attributes:
+      words: vocab words, index == frequency rank (most frequent first).
+      counts: int64 occurrence counts aligned with ``words``.
+      word_index: word -> index map (reference ``vocabHash``, mllib:267).
+      train_words_count: total kept-word occurrences (reference
+        ``trainWordsCount``, mllib:268).
+    """
+
+    words: List[str]
+    counts: np.ndarray
+    word_index: Dict[str, int] = field(repr=False)
+    train_words_count: int
+
+    @property
+    def size(self) -> int:
+        return len(self.words)
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.word_index
+
+    def __getitem__(self, word: str) -> int:
+        return self.word_index[word]
+
+    def get(self, word: str, default=None):
+        return self.word_index.get(word, default)
+
+    def keep_probabilities(self, subsample_ratio: float) -> np.ndarray:
+        """Per-word keep probability for frequency subsampling.
+
+        The intended reference formula (mllib:371-379) is the classic word2vec
+        subsampling rule: with ``f = count/total`` and ratio ``s``,
+
+            keep(w) = (sqrt(f/s) + 1) * (s/f)        -- clipped to [0, 1]
+
+        written in the reference as ``(sqrt(pcn/ratio) + 1) * (ratio/pcn)``
+        where ``pcn = cn / trainWordsCount``. The reference computes ``pcn``
+        with integer division (mllib:375) making subsampling a silent no-op
+        (SURVEY.md §5 "known hazard"); this implementation uses float
+        arithmetic, i.e. implements the *intended* semantics, and is unit
+        tested (the reference could not be).
+
+        A ``subsample_ratio`` of 0 disables subsampling (all-keep), matching
+        the reference default path where the parameter effectively did nothing.
+        """
+        if subsample_ratio <= 0:
+            return np.ones(self.size, dtype=np.float64)
+        pcn = self.counts.astype(np.float64) / float(self.train_words_count)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ran = (np.sqrt(pcn / subsample_ratio) + 1.0) * (subsample_ratio / pcn)
+        ran = np.where(self.counts > 0, ran, 0.0)
+        return np.clip(ran, 0.0, 1.0)
+
+    def encode(self, sentence: Sequence[str]) -> np.ndarray:
+        """Map words to indices, silently dropping OOV words.
+
+        OOV-drop matches the reference training path (``flatMap(bcVocabHash
+        .value.get)``, mllib:336) and the DataFrame transform path (ml:452).
+        """
+        ids = [self.word_index[w] for w in sentence if w in self.word_index]
+        return np.asarray(ids, dtype=np.int32)
+
+    def encode_strict(self, words: Sequence[str]) -> np.ndarray:
+        """Map words to indices, raising on OOV.
+
+        Matches the batched word-transform contract which throws on unseen
+        words (mllib:536).
+        """
+        try:
+            return np.asarray([self.word_index[w] for w in words], dtype=np.int32)
+        except KeyError as e:
+            raise KeyError(f"word {e.args[0]!r} not in vocabulary") from None
+
+
+def build_vocab(
+    sentences: Iterable[Sequence[str]],
+    min_count: int = 5,
+) -> Vocabulary:
+    """Scan a corpus of tokenized sentences into a :class:`Vocabulary`.
+
+    Reference: ``learnVocab`` (mllib:258-279). Index = frequency rank, most
+    frequent word gets index 0; ties broken by first occurrence (stable sort).
+    """
+    counter: collections.Counter = collections.Counter()
+    for sentence in sentences:
+        counter.update(sentence)
+    # Counter preserves insertion (first-seen) order and sort is stable, so
+    # sorting by count desc alone breaks ties by first occurrence.
+    items = [(w, c) for w, c in counter.items() if c >= min_count]
+    items.sort(key=lambda wc: -wc[1])
+    words = [w for w, _ in items]
+    counts = np.asarray([c for _, c in items], dtype=np.int64)
+    word_index = {w: i for i, w in enumerate(words)}
+    train_words_count = int(counts.sum()) if len(counts) else 0
+    if not words:
+        raise ValueError(
+            "The vocabulary size should be > 0. "
+            f"Lower min_count (={min_count}) or supply a larger corpus."
+        )
+    return Vocabulary(
+        words=words,
+        counts=counts,
+        word_index=word_index,
+        train_words_count=train_words_count,
+    )
+
+
+def iter_text_file(path: str, lowercase: bool = False) -> Iterator[List[str]]:
+    """Stream whitespace-tokenized sentences from a text file, one per line."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            toks = line.lower().split() if lowercase else line.split()
+            if toks:
+                yield toks

@@ -1,0 +1,1 @@
+"""Model layer: the Word2Vec estimator and fitted Word2VecModel."""

@@ -1,0 +1,1 @@
+"""Device-side ops: the SGNS step math, on-device negative sampling, top-k."""

@@ -1,0 +1,1 @@
+"""Parallel layer: device mesh construction and the sharded embedding engine."""

@@ -1,0 +1,1 @@
+"""Utilities: parameter validation, logging/metrics, checkpointing."""

@@ -1,0 +1,116 @@
+"""Hyperparameter surface with reference-parity validation.
+
+The reference exposes these as Spark ML ``Params`` with validators
+(ml/feature/ServerSideGlintWord2Vec.scala:40-222) mirrored by fluent setters
+with ``require`` guards on the MLlib trainer (mllib:92-243) and by 11 py4j
+``Param``s in the Python bindings (ml_glintword2vec.py:101-136). Defaults here
+are the reference defaults (mllib:67-81; SURVEY.md §5 config tier 1).
+
+Parameters that exist only to describe the Spark/Akka deployment
+(``numParameterServers``, ``parameterServerHost``, ``parameterServerConfig``,
+``unigramTableSize``) are replaced by mesh geometry: ``num_shards`` is the
+model-axis size of the TPU mesh (the direct analogue of the number of
+parameter servers — each shard owns ``1/num_shards`` of both matrices,
+README.md:69) and ``num_partitions`` maps to the data-parallel axis. The
+Akka message-size guard ``batchSize*n*window <= 10000`` (mllib:154-156) has no
+TPU analogue — there is no message ceiling on ICI — so it is intentionally NOT
+enforced (documented divergence); batch geometry is limited by HBM only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+@dataclass
+class Word2VecParams:
+    """All training/serving hyperparameters, validated on construction.
+
+    Attributes (reference param in parens):
+      vector_size: embedding dimension d (``vectorSize``, default 100).
+      window: max context window (``windowSize``, default 5).
+      step_size: initial learning rate (``stepSize``, default 0.01875).
+      batch_size: center positions per minibatch (``batchSize``, default 50 in
+        the reference; here defaults to 1024 — a TPU-shaped batch. 50-position
+        batches underutilize the chip; quality at larger sync batches is
+        validated by the analogy gates).
+      num_negatives: negatives per (center, context) pair (``n``, default 5).
+      subsample_ratio: frequency subsampling ratio (``subsampleRatio``).
+        The reference *declares* a default of 1e-6 (mllib:75) but its
+        integer-division bug (mllib:375) makes subsampling a no-op, so the
+        reference's de-facto default is "disabled" — and 1e-6 under the
+        *correct* formula discards ~95% of a typical corpus. We therefore
+        default to 0 (disabled, the de-facto reference behavior) and users
+        opting in get the fixed semantics (typical useful values 1e-3..1e-5;
+        see Vocabulary.keep_probabilities).
+      min_count: minimum token frequency (``minCount``, default 5).
+      num_iterations: epochs (``maxIter``/``numIterations``, default 1).
+      max_sentence_length: sentence chunk bound (``maxSentenceLength``, 1000).
+      seed: RNG seed for subsampling/windowing/negatives/init (``seed``).
+      num_partitions: data-parallel axis size (``numPartitions``, default 1).
+      num_shards: model-parallel axis size; 1/num_shards of the vocab rows of
+        syn0/syn1 live on each mesh slice (``numParameterServers``, default 5
+        in the reference; default 1 here — set from the mesh).
+      unigram_power: noise-distribution exponent (fixed 0.75 in word2vec).
+      unigram_table_size: optional quantized-table compatibility mode
+        (``unigramTableSize``; None = exact alias sampling, see corpus.alias).
+      dtype: parameter dtype for the embedding tables ("float32" or
+        "bfloat16"). Dots/updates always accumulate in float32.
+    """
+
+    vector_size: int = 100
+    window: int = 5
+    step_size: float = 0.01875
+    batch_size: int = 1024
+    num_negatives: int = 5
+    subsample_ratio: float = 0.0
+    min_count: int = 5
+    num_iterations: int = 1
+    max_sentence_length: int = 1000
+    seed: int = 1
+    num_partitions: int = 1
+    num_shards: int = 1
+    unigram_power: float = 0.75
+    unigram_table_size: int | None = None
+    dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        self.validate()
+
+    def validate(self) -> None:
+        _require(self.vector_size > 0, "vector_size must be > 0")
+        _require(self.window > 0, "window must be > 0")
+        _require(self.step_size > 0, "step_size must be > 0")
+        _require(self.batch_size > 0, "batch_size must be > 0")
+        _require(self.num_negatives > 0, "num_negatives must be > 0")
+        _require(self.subsample_ratio >= 0, "subsample_ratio must be >= 0")
+        _require(self.min_count >= 0, "min_count must be >= 0")
+        _require(self.num_iterations > 0, "num_iterations must be > 0")
+        _require(self.max_sentence_length > 0, "max_sentence_length must be > 0")
+        _require(self.num_partitions > 0, "num_partitions must be > 0")
+        _require(self.num_shards > 0, "num_shards must be > 0")
+        _require(0 < self.unigram_power <= 1, "unigram_power must be in (0, 1]")
+        _require(
+            self.unigram_table_size is None or self.unigram_table_size > 0,
+            "unigram_table_size must be > 0 or None",
+        )
+        _require(self.dtype in ("float32", "bfloat16"), "dtype must be float32|bfloat16")
+
+    def replace(self, **kwargs) -> "Word2VecParams":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_json(self) -> str:
+        """Persistence metadata, analogous to DefaultParamsWriter metadata +
+        the custom JSON codec for the PS config param (ml:183-195, 504-507)."""
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Word2VecParams":
+        return cls(**json.loads(s))

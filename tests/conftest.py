@@ -1,0 +1,58 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding correctness is validated
+on a virtual 8-device CPU platform exactly as the reference validates its
+distributed stack on a 2-core pseudo-cluster in one container (SURVEY.md §4).
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tiny_corpus():
+    """Deterministic synthetic corpus with learnable structure.
+
+    Mirrors the role of the reference's German-Wikipedia country/capital
+    fixture (ServerSideGlintWord2VecSpec.scala:22-37): small, real structure,
+    fixed seed — big enough for analogy-style quality gates to be meaningful.
+    Countries co-occur with their capitals and a shared 'capital' relation
+    word, plus filler vocabulary for negative-sampling realism.
+    """
+    rng = np.random.default_rng(12345)
+    pairs = [
+        ("germany", "berlin"),
+        ("france", "paris"),
+        ("austria", "vienna"),
+        ("spain", "madrid"),
+        ("italy", "rome"),
+        ("poland", "warsaw"),
+    ]
+    filler = [f"w{i}" for i in range(50)]
+    sentences = []
+    for _ in range(3000):
+        country, capital = pairs[rng.integers(len(pairs))]
+        style = rng.integers(3)
+        noise = list(rng.choice(filler, size=3))
+        if style == 0:
+            s = [capital, "is", "the", "capital", "of", country] + noise
+        elif style == 1:
+            s = noise[:2] + [country, "capital", "city", capital] + noise[2:]
+        else:
+            s = [country, "has", "capital", capital] + noise
+        sentences.append(s)
+    # Pure-filler sentences so filler words reach min_count reliably.
+    for _ in range(500):
+        sentences.append(list(rng.choice(filler, size=8)))
+    rng.shuffle(sentences)
+    return [list(s) for s in sentences]

@@ -1,0 +1,52 @@
+"""Unit tests for the unigram alias sampler (reference: server-side unigram
+table, SURVEY.md §2.2; default size 1e8 at mllib:81)."""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus import build_unigram_alias
+from glint_word2vec_tpu.corpus.alias import build_alias, unigram_weights
+
+
+def test_alias_table_is_exact():
+    # prob/alias decomposition must reproduce the distribution exactly:
+    # p(i) = (prob[i] + sum_{j: alias[j]==i} (1-prob[j])) / n
+    w = np.array([10.0, 1.0, 5.0, 0.5, 3.5])
+    t = build_alias(w)
+    n = t.size
+    p = t.prob.astype(np.float64).copy()
+    recon = p.copy()
+    for j in range(n):
+        if p[j] < 1.0:
+            recon[t.alias[j]] += 1.0 - p[j]
+    np.testing.assert_allclose(recon / n, w / w.sum(), atol=1e-6)
+
+
+def test_sampling_matches_distribution():
+    counts = np.array([1000, 100, 10, 1], dtype=np.int64)
+    t = build_unigram_alias(counts, power=0.75)
+    rng = np.random.default_rng(0)
+    draws = t.sample(rng, 200_000)
+    freq = np.bincount(draws, minlength=4) / draws.size
+    expected = unigram_weights(counts)
+    expected = expected / expected.sum()
+    np.testing.assert_allclose(freq, expected, atol=0.01)
+
+
+def test_quantized_table_size_mode():
+    counts = np.array([10_000, 1], dtype=np.int64)
+    # With a tiny table, the rare word's weight rounds to 0 slots — the
+    # reference's quantized-table behavior.
+    t = build_unigram_alias(counts, table_size=4)
+    rng = np.random.default_rng(0)
+    draws = t.sample(rng, 1000)
+    assert np.all(draws == 0)
+
+
+def test_invalid_weights_raise():
+    with pytest.raises(ValueError):
+        build_alias(np.array([0.0, 0.0]))
+    with pytest.raises(ValueError):
+        build_alias(np.array([-1.0, 2.0]))
+    with pytest.raises(ValueError):
+        build_unigram_alias(np.array([5, 5]), table_size=1)

@@ -1,0 +1,140 @@
+"""Unit tests for subsampling / windowing / batching (reference data passes
+mllib:335-429, previously untestable behind Spark integration)."""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus import (
+    SkipGramBatcher,
+    build_vocab,
+    chunk_sentences,
+    encode_sentences,
+    subsample_sentence,
+    window_batch,
+)
+from glint_word2vec_tpu.corpus.batching import context_width, window_offsets
+
+
+def _vocab():
+    return build_vocab([["a", "b", "c", "d", "e", "f"] * 3], min_count=1)
+
+
+def test_encode_sentences_drops_oov_and_empties():
+    v = _vocab()
+    enc = encode_sentences([["a", "zzz"], ["zzz"], ["b", "c"]], v)
+    assert len(enc) == 2
+    assert enc[0].tolist() == [v["a"]]
+
+
+def test_chunk_sentences_max_length():
+    ids = np.arange(10, dtype=np.int32)
+    chunks = chunk_sentences([ids], max_sentence_length=4)
+    assert [c.tolist() for c in chunks] == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    with pytest.raises(ValueError):
+        chunk_sentences([ids], 0)
+
+
+def test_subsample_keeps_all_when_disabled():
+    ids = np.arange(5, dtype=np.int32)
+    keep = np.ones(5)
+    rng = np.random.default_rng(0)
+    assert subsample_sentence(ids, keep, rng).tolist() == ids.tolist()
+
+
+def test_subsample_rate_statistics():
+    rng = np.random.default_rng(0)
+    ids = np.zeros(10_000, dtype=np.int32)
+    keep = np.array([0.3])
+    kept = subsample_sentence(ids, keep, rng)
+    assert abs(kept.size / ids.size - 0.3) < 0.02
+
+
+def test_context_width_and_offsets():
+    # Reachable offsets are [-(W-1), W-2] (mllib:384-388, exclusive upper).
+    assert context_width(5) == 7
+    assert window_offsets(5).tolist() == [-4, -3, -2, -1, 1, 2, 3]
+    assert context_width(2) == 1
+    assert window_offsets(2).tolist() == [-1]
+    # window=1 trains nothing in the reference; one permanently-dead lane.
+    assert context_width(1) == 1
+
+
+def test_window_batch_reference_semantics():
+    # b ~ U[0, window); context positions [max(0,i-b), min(i+b,len)) \ {i}
+    # (mllib:384-388). Check bounds and mask consistency over many draws.
+    ids = np.arange(7, dtype=np.int32)
+    W = 3
+    C = context_width(W)
+    rng = np.random.default_rng(0)
+    seen_nonempty = False
+    for _ in range(50):
+        c, x, m = window_batch(ids, W, rng)
+        assert c.shape == (7,)
+        assert x.shape == (7, C) and m.shape == (7, C)
+        offsets = window_offsets(W)
+        for i in range(7):
+            valid_offsets = offsets[m[i] > 0]
+            if valid_offsets.size:
+                seen_nonempty = True
+                # upper bound is exclusive: max positive offset <= b-1 <= W-2
+                assert valid_offsets.max(initial=-W) <= W - 2
+                ctx_pos = i + valid_offsets
+                assert np.all((ctx_pos >= 0) & (ctx_pos < 7))
+                np.testing.assert_array_equal(x[i][m[i] > 0], ids[ctx_pos])
+            # masked slots are zero-padded
+            assert np.all(x[i][m[i] == 0] == 0)
+    assert seen_nonempty
+
+
+def test_window_batch_window1_trains_nothing():
+    # Reference: window=1 -> b=0 always -> empty context for every position.
+    c, x, m = window_batch(np.arange(9, dtype=np.int32), 1, np.random.default_rng(0))
+    assert m.sum() == 0.0
+
+
+def test_window_batch_empty_sentence():
+    c, x, m = window_batch(np.zeros(0, np.int32), 2, np.random.default_rng(0))
+    assert c.shape == (0,) and x.shape == (0, context_width(2))
+
+
+def test_batcher_static_shapes_and_coverage():
+    v = _vocab()
+    sents = [v.encode(["a", "b", "c", "d", "e", "f"]) for _ in range(10)]
+    b = SkipGramBatcher(sents, v, batch_size=16, window=2, subsample_ratio=0.0)
+    batches = list(b.epoch(0))
+    assert all(bb.centers.shape == (16,) for bb in batches)
+    assert all(bb.contexts.shape == (16, context_width(2)) for bb in batches)
+    # 60 positions total -> 4 batches, last one padded
+    total_real = sum(int((bb.mask.sum(axis=1) > 0).sum()) for bb in batches)
+    assert len(batches) == 4
+    # Padded rows have fully-zero masks; centers of padded rows are 0.
+    assert batches[-1].mask[-1].sum() == 0.0
+    assert total_real <= 60
+    assert b.words_done == 60
+
+
+def test_batcher_epoch_determinism_and_epoch_variation():
+    v = _vocab()
+    sents = [v.encode(["a", "b", "c", "d", "e", "f"]) for _ in range(5)]
+
+    def collect(epoch):
+        b = SkipGramBatcher(sents, v, 8, 2, subsample_ratio=0.0, seed=7)
+        return [(x.centers.copy(), x.contexts.copy(), x.mask.copy()) for x in b.epoch(epoch)]
+
+    a1, a2, b1 = collect(0), collect(0), collect(1)
+    for (c1, x1, m1), (c2, x2, m2) in zip(a1, a2):
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(m1, m2)
+    # different epoch -> different window draws (reference reseeds k^idx)
+    assert any(
+        not np.array_equal(m1, m2) for (_, _, m1), (_, _, m2) in zip(a1, b1)
+    )
+
+
+def test_batcher_validates_args():
+    v = _vocab()
+    with pytest.raises(ValueError):
+        SkipGramBatcher([], v, 0, 2)
+    with pytest.raises(ValueError):
+        SkipGramBatcher([], v, 8, 0)

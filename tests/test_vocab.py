@@ -1,0 +1,73 @@
+"""Unit tests for vocabulary construction (reference: mllib:258-279).
+
+The reference has zero unit tests (SURVEY.md §4); these cover the semantics
+its integration suite could never isolate.
+"""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus import build_vocab
+from glint_word2vec_tpu.corpus.vocab import iter_text_file
+
+
+def test_frequency_rank_indexing():
+    sents = [["a", "b", "a", "c"], ["a", "b", "c"], ["a", "d"]]
+    v = build_vocab(sents, min_count=1)
+    # a:4 b:2 c:2 d:1 -> index by count desc, ties by first-seen
+    assert v.words == ["a", "b", "c", "d"]
+    assert v.word_index == {"a": 0, "b": 1, "c": 2, "d": 3}
+    assert v.counts.tolist() == [4, 2, 2, 1]
+    assert v.train_words_count == 9
+
+
+def test_min_count_filters_and_total_counts_kept_only():
+    sents = [["a"] * 5 + ["b"] * 2 + ["rare"]]
+    v = build_vocab(sents, min_count=2)
+    assert "rare" not in v
+    assert v.train_words_count == 7  # only kept words counted (mllib:268)
+
+
+def test_empty_vocab_raises():
+    with pytest.raises(ValueError, match="vocabulary size"):
+        build_vocab([["a"]], min_count=5)
+
+
+def test_encode_drops_oov_and_strict_raises():
+    v = build_vocab([["a", "b", "a"]], min_count=1)
+    assert v.encode(["a", "zzz", "b"]).tolist() == [0, 1]
+    with pytest.raises(KeyError, match="zzz"):
+        v.encode_strict(["a", "zzz"])
+
+
+def test_keep_probabilities_fixed_semantics():
+    # The intended formula: keep = (sqrt(f/s)+1) * s/f, clipped to [0,1].
+    sents = [["hot"] * 9990 + ["cold"] * 10]
+    v = build_vocab(sents, min_count=1)
+    kp = v.keep_probabilities(subsample_ratio=0.01)
+    f_hot = 0.999
+    expected_hot = (np.sqrt(f_hot / 0.01) + 1) * (0.01 / f_hot)
+    assert kp[v["hot"]] == pytest.approx(min(1.0, expected_hot), rel=1e-6)
+    # Rare word (f = 0.001 < ratio): formula value > 1 -> clipped to keep-always.
+    assert kp[v["cold"]] == pytest.approx(1.0)
+    # Disabled subsampling keeps everything (the reference's de-facto behavior).
+    assert np.all(v.keep_probabilities(0.0) == 1.0)
+
+
+def test_keep_probabilities_not_integer_division_noop():
+    # Regression guard for the reference bug (mllib:375): with real float
+    # math, a dominating word must get keep-prob < 1.
+    sents = [["the"] * 10000 + ["x"] * 10]
+    v = build_vocab(sents, min_count=1)
+    kp = v.keep_probabilities(subsample_ratio=1e-3)
+    assert kp[v["the"]] < 0.2
+
+
+def test_iter_text_file(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_text("A b c\n\nd E\n", encoding="utf-8")
+    assert list(iter_text_file(str(p))) == [["A", "b", "c"], ["d", "E"]]
+    assert list(iter_text_file(str(p), lowercase=True)) == [
+        ["a", "b", "c"],
+        ["d", "e"],
+    ]
