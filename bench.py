@@ -1,0 +1,90 @@
+"""Benchmark: sustained SGNS training throughput on the available device.
+
+Measures the fused train step (the dotprod+adjust equivalent) in steady
+state on a realistic large-vocab configuration, reporting trained words per
+second per chip. Baseline: the driver north-star of 50M words/sec on a
+v5e-32 (BASELINE.json) = 1.5625M words/sec/chip; the reference itself
+publishes no throughput numbers (BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "words/sec/chip", "vs_baseline": N}
+
+Environment knobs (for smoke-testing on CPU):
+  BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_STEPS, BENCH_PLATFORM
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_WORDS_PER_SEC_PER_CHIP = 50e6 / 32
+
+
+def main() -> None:
+    if os.environ.get("BENCH_PLATFORM"):
+        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    V = int(os.environ.get("BENCH_VOCAB", 1_000_000))
+    d = int(os.environ.get("BENCH_DIM", 300))
+    B = int(os.environ.get("BENCH_BATCH", 8192))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
+    C, n = 7, 5  # window=5 context lanes, 5 negatives (reference defaults)
+
+    # Zipf-ish counts: realistic index skew for gathers and the noise table.
+    ranks = np.arange(1, V + 1, dtype=np.float64)
+    counts = np.maximum((1e9 / ranks), 1.0).astype(np.int64)
+
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    eng = EmbeddingEngine(mesh, V, d, counts, num_negatives=n, seed=0)
+
+    rng = np.random.default_rng(0)
+    # Zipf-distributed center/context draws (the hot rows dominate, as in
+    # real corpora after subsampling).
+    p = (counts / counts.sum()).astype(np.float64)
+    n_unique_batches = 8
+    batches = []
+    for _ in range(n_unique_batches):
+        centers = rng.choice(V, size=B, p=p).astype(np.int32)
+        contexts = rng.choice(V, size=(B, C), p=p).astype(np.int32)
+        mask = (rng.random((B, C)) < 0.85).astype(np.float32)
+        batches.append((centers, contexts, mask))
+
+    key = jax.random.PRNGKey(0)
+    # Warm up / compile.
+    loss = eng.train_step(*batches[0], key, 0.025)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    last = None
+    for i in range(steps):
+        c, x, m = batches[i % n_unique_batches]
+        last = eng.train_step(c, x, m, jax.random.fold_in(key, i), 0.025)
+    jax.block_until_ready(last)
+    dt = time.time() - t0
+
+    words = B * steps  # trained center positions == reference word count
+    wps = words / dt
+    print(
+        json.dumps(
+            {
+                "metric": "sgns_train_throughput",
+                "value": round(wps, 1),
+                "unit": "words/sec/chip",
+                "vs_baseline": round(wps / BASELINE_WORDS_PER_SEC_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -27,16 +27,18 @@ Public API (capability map to the reference, see SURVEY.md §2):
 
 from glint_word2vec_tpu.version import __version__
 
-# NOTE: "Word2Vec"/"Word2VecModel" join __all__ when models/word2vec.py lands.
 __all__ = [
     "__version__",
+    "Word2Vec",
+    "Word2VecModel",
+    "LocalWord2VecModel",
     "Word2VecParams",
 ]
 
 
 def __getattr__(name):
     # Lazy so that host-only use (corpus tooling) never imports jax.
-    if name in ("Word2Vec", "Word2VecModel"):
+    if name in ("Word2Vec", "Word2VecModel", "LocalWord2VecModel"):
         from glint_word2vec_tpu.models import word2vec
 
         return getattr(word2vec, name)

@@ -152,8 +152,11 @@ class SkipGramBatcher:
     exactly ``batch_size`` center positions — the final partial batch is
     zero-padded with mask 0 rows so device shapes stay static.
 
-    ``words_done`` counts post-subsampling trained words, the quantity the
-    reference accumulates for its LR schedule (mllib:401-413).
+    ``words_done`` counts *pre-subsampling* words (original word2vec
+    convention, and the reference's effective behavior since its subsampling
+    is a no-op): the LR anneal in fit() divides by ``num_iterations *
+    train_words_count`` (mllib:405-410), so counting kept words only would
+    stall the schedule whenever subsampling discards tokens.
     """
 
     def __init__(
@@ -192,8 +195,8 @@ class SkipGramBatcher:
         buf_m = np.zeros((B, W2), dtype=np.float32)
         fill = 0
         for si in order:
+            self.words_done += int(self.sentences[si].size)
             ids = subsample_sentence(self.sentences[si], self.keep_prob, rng)
-            self.words_done += int(ids.size)
             c, x, m = window_batch(ids, self.window, rng)
             n = c.shape[0]
             start = 0

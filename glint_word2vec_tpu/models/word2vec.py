@@ -1,0 +1,446 @@
+"""The Word2Vec estimator and fitted model — the user-facing API layer.
+
+Reference mapping (SURVEY.md §2):
+  - :class:`Word2Vec` = the trainer/estimator pair C1+C6
+    (mllib/feature/ServerSideGlintWord2Vec.scala:65-451 and
+    ml/feature/ServerSideGlintWord2Vec.scala:228-317), with the reference's
+    fluent setter surface (mllib:92-243) in snake_case.
+  - :class:`Word2VecModel` = the model pair C3+C7 (mllib:460-669,
+    ml:319-497): transform in its three reference flavors, findSynonyms,
+    analogy arithmetic, getVectors, toLocal, save/load/stop.
+  - :class:`LocalWord2VecModel` = the ``toLocal`` result (mllib:651-657):
+    a host-only numpy model with the same query surface.
+
+The PS-cluster topology parameters (``parameterServerHost``,
+``parameterServerConfig``) have no analogue — device placement is a
+``jax.sharding.Mesh`` passed directly (or defaulted) — and the training loop
+is synchronous: one jit step per minibatch instead of the reference's
+per-partition async future chains (mllib:417-429).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from glint_word2vec_tpu.corpus.batching import (
+    SkipGramBatcher,
+    chunk_sentences,
+    context_width,
+    encode_sentences,
+)
+from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.utils.params import Word2VecParams
+
+logger = logging.getLogger(__name__)
+
+#: Rows per query chunk — the reference batches word/sentence requests
+#: 10,000 at a time (mllib:531, ml:449). Here it only bounds HBM spikes.
+MAX_QUERY_ROWS = 10_000
+
+
+class Word2Vec:
+    """Skip-gram/negative-sampling estimator over a TPU mesh.
+
+    Construct with a :class:`Word2VecParams`, keyword overrides, or use the
+    reference-style fluent setters::
+
+        model = (Word2Vec()
+                 .set_vector_size(100)
+                 .set_window_size(5)
+                 .set_step_size(0.025)
+                 .set_seed(1)
+                 .fit(sentences))
+    """
+
+    def __init__(
+        self,
+        params: Optional[Word2VecParams] = None,
+        mesh=None,
+        **overrides,
+    ):
+        self.params = (params or Word2VecParams()).replace(**overrides)
+        self.mesh = mesh
+
+    # Fluent setters (reference mllib:92-243 / python bindings :172-302).
+    def _set(self, **kw) -> "Word2Vec":
+        self.params = self.params.replace(**kw)
+        return self
+
+    def set_vector_size(self, v: int) -> "Word2Vec":
+        return self._set(vector_size=v)
+
+    def set_window_size(self, v: int) -> "Word2Vec":
+        return self._set(window=v)
+
+    def set_step_size(self, v: float) -> "Word2Vec":
+        return self._set(step_size=v)
+
+    def set_batch_size(self, v: int) -> "Word2Vec":
+        return self._set(batch_size=v)
+
+    def set_num_negatives(self, v: int) -> "Word2Vec":
+        """Reference param ``n`` (negative samples per positive pair)."""
+        return self._set(num_negatives=v)
+
+    def set_subsample_ratio(self, v: float) -> "Word2Vec":
+        return self._set(subsample_ratio=v)
+
+    def set_min_count(self, v: int) -> "Word2Vec":
+        return self._set(min_count=v)
+
+    def set_num_iterations(self, v: int) -> "Word2Vec":
+        return self._set(num_iterations=v)
+
+    def set_max_sentence_length(self, v: int) -> "Word2Vec":
+        return self._set(max_sentence_length=v)
+
+    def set_seed(self, v: int) -> "Word2Vec":
+        return self._set(seed=v)
+
+    def set_num_partitions(self, v: int) -> "Word2Vec":
+        """Data-parallel axis size (reference ``numPartitions``)."""
+        return self._set(num_partitions=v)
+
+    def set_num_shards(self, v: int) -> "Word2Vec":
+        """Model-parallel axis size (reference ``numParameterServers``)."""
+        return self._set(num_shards=v)
+
+    def set_dtype(self, v: str) -> "Word2Vec":
+        return self._set(dtype=v)
+
+    # ------------------------------------------------------------------
+
+    def _make_mesh(self):
+        from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+        if self.mesh is not None:
+            return self.mesh
+        p = self.params
+        return make_mesh(p.num_partitions, p.num_shards)
+
+    def fit(self, sentences: Iterable[Sequence[str]]) -> "Word2VecModel":
+        """Train on an iterable of tokenized sentences.
+
+        The full reference ``fit`` path (mllib:310-439): vocab scan ->
+        encode/chunk -> per-epoch subsample+window passes -> minibatched
+        SGNS with the linear LR anneal (floor ``step_size * 1e-4``,
+        mllib:405-413) -> fitted model.
+        """
+        import jax
+
+        from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+
+        p = self.params
+        sentences = list(sentences) if not isinstance(sentences, list) else sentences
+        vocab = build_vocab(sentences, min_count=p.min_count)
+        logger.info(
+            "vocab: %d words, %d train words", vocab.size, vocab.train_words_count
+        )
+        encoded = chunk_sentences(
+            encode_sentences(sentences, vocab), p.max_sentence_length
+        )
+        mesh = self._make_mesh()
+        if p.batch_size % mesh.shape["data"]:
+            raise ValueError(
+                f"batch_size ({p.batch_size}) must be divisible by the "
+                f"data-axis size ({mesh.shape['data']})"
+            )
+        engine = EmbeddingEngine(
+            mesh,
+            vocab.size,
+            p.vector_size,
+            vocab.counts,
+            num_negatives=p.num_negatives,
+            unigram_power=p.unigram_power,
+            unigram_table_size=p.unigram_table_size,
+            seed=p.seed,
+            dtype=p.dtype,
+        )
+        batcher = SkipGramBatcher(
+            encoded,
+            vocab,
+            batch_size=p.batch_size,
+            window=p.window,
+            subsample_ratio=p.subsample_ratio,
+            seed=p.seed,
+        )
+        # LR schedule denominator: iterations * total train words + 1
+        # (reference ``totalWordsCount``, mllib:405-410).
+        total_words = p.num_iterations * vocab.train_words_count + 1
+        base_key = jax.random.PRNGKey(p.seed)
+        step = 0
+        t0 = time.time()
+        words_at_log, t_log = 0, t0
+        loss = None
+        for epoch in range(p.num_iterations):
+            for batch in batcher.epoch(epoch):
+                alpha = max(
+                    p.step_size * (1 - batch.words_done / total_words),
+                    p.step_size * 1e-4,
+                )
+                key = jax.random.fold_in(base_key, step)
+                loss = engine.train_step(
+                    batch.centers, batch.contexts, batch.mask, key, alpha
+                )
+                step += 1
+                if step % 200 == 0:
+                    now = time.time()
+                    wps = (batch.words_done - words_at_log) / max(now - t_log, 1e-9)
+                    logger.info(
+                        "epoch %d step %d: alpha=%.5f loss=%.4f %.0f words/s",
+                        epoch, step, alpha, float(loss), wps,
+                    )
+                    words_at_log, t_log = batch.words_done, now
+        dt = time.time() - t0
+        logger.info(
+            "trained %d steps / %d words in %.1fs (%.0f words/s)",
+            step, batcher.words_done, dt, batcher.words_done / max(dt, 1e-9),
+        )
+        return Word2VecModel(vocab, engine, p)
+
+
+class Word2VecModel:
+    """Fitted model: query/serving surface over the sharded matrix."""
+
+    def __init__(self, vocab: Vocabulary, engine, params: Word2VecParams):
+        self.vocab = vocab
+        self.engine = engine
+        self.params = params
+
+    # ------------------------------------------------------------------
+    # transform — the reference's three flavors (SURVEY.md §3.2)
+    # ------------------------------------------------------------------
+
+    @property
+    def vector_size(self) -> int:
+        return self.engine.cols
+
+    def transform(self, word: str) -> np.ndarray:
+        """Single word -> vector. Raises KeyError on OOV (mllib:511-519;
+        documented there as the slow path — one pull per word)."""
+        idx = self.vocab.word_index.get(word)
+        if idx is None:
+            raise KeyError(f"word {word!r} not in vocabulary")
+        return np.asarray(self.engine.pull(np.array([idx], np.int32)))[0]
+
+    def transform_words(self, words: Sequence[str]) -> np.ndarray:
+        """Batch of words -> (N, d). Raises on OOV, requests chunked
+        MAX_QUERY_ROWS at a time (mllib:529-543)."""
+        idx = self.vocab.encode_strict(words)
+        out = np.empty((len(idx), self.vector_size), np.float32)
+        for s in range(0, len(idx), MAX_QUERY_ROWS):
+            out[s : s + MAX_QUERY_ROWS] = np.asarray(
+                self.engine.pull(idx[s : s + MAX_QUERY_ROWS])
+            )
+        return out
+
+    def transform_sentences(
+        self, sentences: Iterable[Sequence[str]]
+    ) -> np.ndarray:
+        """Sentences -> (S, d) mean vectors, computed device-side.
+
+        The DataFrame ``transform`` path (ml:443-459): OOV words silently
+        dropped, rows chunked MAX_QUERY_ROWS at a time, empty/all-OOV
+        sentences yield zero vectors. Only S*d floats return to host
+        (the ``pullAverage`` network-efficiency property)."""
+        sents = [self.vocab.encode(s) for s in sentences]
+        d = self.vector_size
+        out = np.zeros((len(sents), d), np.float32)
+        for s in range(0, len(sents), MAX_QUERY_ROWS):
+            block = sents[s : s + MAX_QUERY_ROWS]
+            L = max((len(x) for x in block), default=0)
+            if L == 0:
+                continue
+            idx = np.zeros((len(block), L), np.int32)
+            m = np.zeros((len(block), L), np.float32)
+            for i, x in enumerate(block):
+                idx[i, : len(x)] = x
+                m[i, : len(x)] = 1.0
+            out[s : s + len(block)] = np.asarray(
+                self.engine.pull_average(idx, m)
+            )
+        return out
+
+    # ------------------------------------------------------------------
+    # Similarity / analogy serving (SURVEY.md §3.3)
+    # ------------------------------------------------------------------
+
+    def find_synonyms(self, word: str, num: int) -> List[Tuple[str, float]]:
+        """Top-``num`` most-similar words, the query word excluded
+        (mllib:554-560: fetch num+1 then drop the word itself)."""
+        vec = self.transform(word)
+        results = self.find_synonyms_vector(vec, num + 1)
+        return [(w, s) for w, s in results if w != word][:num]
+
+    def find_synonyms_vector(
+        self, vector: np.ndarray, num: int
+    ) -> List[Tuple[str, float]]:
+        """Top-``num`` words by cosine similarity to an arbitrary vector
+        (mllib:570-629) — distributed matvec + on-device top-k instead of
+        the reference's O(vocab) driver-side scan."""
+        if num <= 0:
+            raise ValueError("num must be > 0")
+        num = min(num, self.vocab.size)
+        sims, idx = self.engine.top_k_cosine(np.asarray(vector, np.float32), num)
+        return [
+            (self.vocab.words[int(i)], float(s))
+            for s, i in zip(sims, idx)
+            if int(i) < self.vocab.size
+        ]
+
+    def analogy(
+        self, positive: Sequence[str], negative: Sequence[str], num: int
+    ) -> List[Tuple[str, float]]:
+        """king - man + woman style queries: sum(positive) - sum(negative),
+        query words excluded from results. The reference exposes this as
+        caller-side vector arithmetic + findSynonyms
+        (ServerSideGlintWord2VecSpec.scala:342-344); provided here as a
+        first-class method."""
+        vec = np.zeros(self.vector_size, np.float32)
+        for w in positive:
+            vec += self.transform(w)
+        for w in negative:
+            vec -= self.transform(w)
+        exclude = set(positive) | set(negative)
+        res = self.find_synonyms_vector(vec, num + len(exclude))
+        return [(w, s) for w, s in res if w not in exclude][:num]
+
+    # ------------------------------------------------------------------
+    # Export (SURVEY.md §2 C3 getVectors / toLocal)
+    # ------------------------------------------------------------------
+
+    def get_vectors(self) -> Iterator[Tuple[str, np.ndarray]]:
+        """Stream (word, vector) pairs, pulled MAX_QUERY_ROWS at a time
+        (mllib:638-644 / ml:342-364) — never materializes the full matrix
+        on host, killing the reference's 8 GB broadcast ceiling
+        (README.md:71-73)."""
+        for s in range(0, self.vocab.size, MAX_QUERY_ROWS):
+            idx = np.arange(s, min(s + MAX_QUERY_ROWS, self.vocab.size), dtype=np.int32)
+            rows = np.asarray(self.engine.pull(idx))
+            for i, r in zip(idx, rows):
+                yield self.vocab.words[int(i)], r
+
+    def to_local(self) -> "LocalWord2VecModel":
+        """Materialize a host-side numpy model (mllib:651-657)."""
+        vecs = np.empty((self.vocab.size, self.vector_size), np.float32)
+        for s in range(0, self.vocab.size, MAX_QUERY_ROWS):
+            idx = np.arange(s, min(s + MAX_QUERY_ROWS, self.vocab.size), dtype=np.int32)
+            vecs[s : s + len(idx)] = np.asarray(self.engine.pull(idx))
+        return LocalWord2VecModel(list(self.vocab.words), vecs)
+
+    # ------------------------------------------------------------------
+    # Persistence / lifecycle (SURVEY.md §3.4)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Matrix shards + words list + params metadata (mllib:493-498:
+        ``matrix.save`` + the words text file; ml:504-507 params metadata)."""
+        os.makedirs(path, exist_ok=True)
+        self.engine.save(os.path.join(path, "matrix"))
+        with open(os.path.join(path, "words.txt"), "w", encoding="utf-8") as f:
+            for w in self.vocab.words:
+                if "\n" in w or "\r" in w:
+                    raise ValueError(
+                        f"vocab word {w!r} contains a newline and cannot be "
+                        "saved to the line-oriented words file"
+                    )
+                f.write(w + "\n")
+        with open(os.path.join(path, "params.json"), "w") as f:
+            f.write(self.params.to_json())
+
+    @classmethod
+    def load(cls, path: str, mesh=None) -> "Word2VecModel":
+        """Rebuild from :meth:`save` output onto any mesh — the analogue of
+        loading onto a fresh or *different* PS cluster (mllib:696-725;
+        host-override at ml:584-586)."""
+        from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+        from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+        with open(os.path.join(path, "params.json")) as f:
+            params = Word2VecParams.from_json(f.read())
+        with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
+            words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        if mesh is None:
+            mesh = make_mesh(params.num_partitions, params.num_shards)
+        engine = EmbeddingEngine.load(os.path.join(path, "matrix"), mesh)
+        counts = engine._counts
+        if len(words) != engine.vocab_size:
+            raise ValueError(
+                f"corrupt model at {path}: words file has {len(words)} "
+                f"entries but the matrix holds {engine.vocab_size} rows"
+            )
+        vocab = Vocabulary(
+            words=words,
+            counts=counts,
+            word_index={w: i for i, w in enumerate(words)},
+            train_words_count=int(counts.sum()),
+        )
+        return cls(vocab, engine, params)
+
+    def stop(self) -> None:
+        """Release device memory (reference ``model.stop`` terminating the
+        PS client/cluster, mllib:664-667)."""
+        self.engine.destroy()
+
+
+class LocalWord2VecModel:
+    """Host-only numpy model — the ``toLocal`` result (mllib:651-657).
+
+    Same query surface, no device required; convertible back by training
+    code via ``EmbeddingEngine.set_tables`` if needed.
+    """
+
+    def __init__(self, words: List[str], vectors: np.ndarray):
+        if vectors.shape[0] != len(words):
+            raise ValueError("words/vectors length mismatch")
+        self.words = words
+        self.vectors = vectors.astype(np.float32)
+        self.word_index = {w: i for i, w in enumerate(words)}
+        self._norms = np.linalg.norm(self.vectors, axis=1)
+
+    @property
+    def vector_size(self) -> int:
+        return self.vectors.shape[1]
+
+    def transform(self, word: str) -> np.ndarray:
+        idx = self.word_index.get(word)
+        if idx is None:
+            raise KeyError(f"word {word!r} not in vocabulary")
+        return self.vectors[idx]
+
+    def find_synonyms_vector(self, vector, num: int) -> List[Tuple[str, float]]:
+        v = np.asarray(vector, np.float32)
+        nv = np.linalg.norm(v)
+        if nv > 0:
+            v = v / nv
+        safe = np.where(self._norms > 0, self._norms, 1.0)
+        cos = np.where(self._norms > 0, (self.vectors @ v) / safe, 0.0)
+        top = np.argsort(-cos)[:num]
+        return [(self.words[i], float(cos[i])) for i in top]
+
+    def find_synonyms(self, word: str, num: int) -> List[Tuple[str, float]]:
+        res = self.find_synonyms_vector(self.transform(word), num + 1)
+        return [(w, s) for w, s in res if w != word][:num]
+
+    def get_vectors(self) -> Dict[str, np.ndarray]:
+        return {w: self.vectors[i] for i, w in enumerate(self.words)}
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.save(os.path.join(path, "vectors.npy"), self.vectors)
+        with open(os.path.join(path, "words.txt"), "w", encoding="utf-8") as f:
+            for w in self.words:
+                f.write(w + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "LocalWord2VecModel":
+        vectors = np.load(os.path.join(path, "vectors.npy"))
+        with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
+            words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        return cls(words, vectors)

@@ -1,0 +1,29 @@
+"""On-device negative sampling from the unigram^0.75 noise distribution.
+
+Reference: the Glint servers draw ``n`` negatives per (center, context) pair
+from a shared quantized unigram table, seeded by the client so all servers
+draw identically (``matrix.dotprod(..., seed)``, mllib:420-421; SURVEY.md
+§2.2). Here the draw happens *inside* the jit-compiled train step from a
+replicated alias table (see corpus/alias.py): O(1) per draw, exact
+distribution, reproducible from the step's PRNG key — the same contract
+(seed -> identical negatives everywhere) without a server round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_negatives(
+    key: jax.Array,
+    prob: jax.Array,  # (V,) float32 alias acceptance probabilities
+    alias: jax.Array,  # (V,) int32 alias targets
+    shape: tuple,
+) -> jax.Array:
+    """Draw ``shape`` samples from the alias table: int32 indices in [0, V)."""
+    k_key, u_key = jax.random.split(key)
+    vocab = prob.shape[0]
+    k = jax.random.randint(k_key, shape, 0, vocab, dtype=jnp.int32)
+    u = jax.random.uniform(u_key, shape, dtype=jnp.float32)
+    return jnp.where(u < prob[k], k, alias[k])

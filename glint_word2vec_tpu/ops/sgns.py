@@ -1,0 +1,171 @@
+"""Skip-gram negative-sampling step math, as pure jit-compatible functions.
+
+This module is the TPU restatement of the reference's hot loop: the
+client/server round-trip pair ``matrix.dotprod`` (servers compute partial dot
+products for positive and negative pairs, mllib:421) + ``matrix.adjust``
+(servers replay cached indices and apply rank-1 SGD updates, mllib:425),
+with the client-side sigmoid-LUT gradient scaling between them
+(mllib:422-424). Here all three fuse into one on-device function: gather ->
+batched dot products (MXU) -> exact sigmoid (documented divergence from the
+reference's 1000-bin LUT, mllib:281-302 — the LUT was a CPU optimization; on
+TPU the exact form is free) -> scalar gradient coefficients -> scatter-add
+rank-1 updates. The RPC cache keys (``cacheKeys``) dissolve: the "cache" is
+simply values held in registers/VMEM between the two halves of the fused op.
+
+All functions are shape-polymorphic in batch B, context lanes C, negatives n,
+and embedding dim d, and run identically per-shard under ``shard_map`` (the
+sharded engine in parallel/engine.py supplies gathered rows and consumes
+per-row updates).
+
+Padding convention: padded context lanes / padded batch rows carry index 0
+and mask 0.0; every gradient coefficient is multiplied by its mask, so
+padded entries contribute exactly zero to every scatter-add.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from glint_word2vec_tpu.ops.sampling import sample_negatives
+
+
+class SgnsGrads(NamedTuple):
+    """Scalar gradient coefficients + center-row gradient for one minibatch.
+
+    ``c_pos``/``c_neg`` are exactly the reference's ``gPlus``/``gMinus``
+    scalars (the only payload the client ever sends back to the servers,
+    mllib:422-425): the SGD coefficient multiplying the *other* side's row in
+    each rank-1 update, learning rate included.
+    """
+
+    c_pos: jax.Array  # (B, C)    alpha * (1 - sigmoid(f_pos)) * mask
+    c_neg: jax.Array  # (B, C, n) alpha * (0 - sigmoid(f_neg)) * mask
+    d_center: jax.Array  # (B, d)  gradient w.r.t. syn0[centers]
+    loss: jax.Array  # () masked-mean SGNS loss (monitoring only)
+
+
+def sgns_grads(
+    h: jax.Array,  # (B, d) float32 — syn0 rows of the centers
+    u_pos: jax.Array,  # (B, C, d) float32 — syn1 rows of the contexts
+    u_neg: jax.Array,  # (B, C, n, d) float32 — syn1 rows of the negatives
+    mask: jax.Array,  # (B, C) float32 — 1.0 where the context slot is real
+    neg_mask: jax.Array,  # (B, C, n) float32 — negatives kept (see train step)
+    alpha: jax.Array,  # () float32 learning rate
+) -> SgnsGrads:
+    """Forward + backward of the SGNS objective for pre-gathered rows.
+
+    Objective per real (center, context) pair (README.md:10-15 model):
+        L = -log sigma(u_ctx . h) - sum_n log sigma(-u_neg . h)
+    SGD coefficients (matching the reference's label-vs-sigmoid form at
+    mllib:422-424): c_pos = alpha*(1 - sigma(f_pos)), c_neg = -alpha*sigma(f_neg).
+    """
+    f_pos = jnp.einsum("bd,bcd->bc", h, u_pos)  # (B, C)
+    f_neg = jnp.einsum("bd,bcnd->bcn", h, u_neg)  # (B, C, n)
+    s_pos = jax.nn.sigmoid(f_pos)
+    s_neg = jax.nn.sigmoid(f_neg)
+
+    c_pos = alpha * (1.0 - s_pos) * mask
+    c_neg = -alpha * s_neg * neg_mask
+
+    # d L/d h, with the learning rate folded in (pure SGD step direction).
+    d_center = jnp.einsum("bc,bcd->bd", c_pos, u_pos) + jnp.einsum(
+        "bcn,bcnd->bd", c_neg, u_neg
+    )
+
+    # Monitoring loss (exact, masked mean over real pairs).
+    log_sig = jax.nn.log_sigmoid
+    pair_loss = -log_sig(f_pos) * mask - jnp.sum(
+        log_sig(-f_neg) * neg_mask, axis=-1
+    ) * mask
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = pair_loss.sum() / denom
+    return SgnsGrads(c_pos=c_pos, c_neg=c_neg, d_center=d_center, loss=loss)
+
+
+def init_tables(
+    key: jax.Array, vocab_size: int, dim: int, dtype=jnp.float32
+) -> Tuple[jax.Array, jax.Array]:
+    """word2vec-standard init: syn0 ~ U[-0.5/d, 0.5/d), syn1 = 0."""
+    syn0 = (
+        jax.random.uniform(key, (vocab_size, dim), dtype=jnp.float32) - 0.5
+    ) / dim
+    return syn0.astype(dtype), jnp.zeros((vocab_size, dim), dtype=dtype)
+
+
+def negative_mask(
+    negs: jax.Array,  # (B, C, n) int32
+    contexts: jax.Array,  # (B, C) int32
+    mask: jax.Array,  # (B, C) float32
+) -> jax.Array:
+    """Mask for negative draws: drop a negative that equals its positive
+    context word (the standard word2vec "target == word" skip), and zero out
+    draws for padded context lanes."""
+    keep = (negs != contexts[..., None]).astype(jnp.float32)
+    return keep * mask[..., None]
+
+
+def train_step(
+    syn0: jax.Array,  # (V, d)
+    syn1: jax.Array,  # (V, d)
+    prob: jax.Array,  # (V,) alias acceptance probs
+    alias: jax.Array,  # (V,) alias targets
+    centers: jax.Array,  # (B,) int32
+    contexts: jax.Array,  # (B, C) int32
+    mask: jax.Array,  # (B, C) float32
+    key: jax.Array,
+    alpha: jax.Array,  # () float32
+    num_negatives: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused single-device SGNS minibatch update.
+
+    Returns (new_syn0, new_syn1, loss). Jit with donated syn0/syn1 for
+    in-place HBM updates. Duplicate indices within a batch *sum* their
+    updates (XLA scatter-add) — the synchronous-batch semantics replacing the
+    reference's async Hogwild races (SURVEY.md §2.3, §7 hard part 3).
+    """
+    B, C = contexts.shape
+    negs = sample_negatives(key, prob, alias, (B, C, num_negatives))
+    compute = jnp.float32
+    h = syn0[centers].astype(compute)
+    u_pos = syn1[contexts].astype(compute)
+    u_neg = syn1[negs].astype(compute)
+    nmask = negative_mask(negs, contexts, mask)
+
+    g = sgns_grads(h, u_pos, u_neg, mask, nmask, alpha.astype(compute))
+
+    # Rank-1 updates, scatter-added into the tables.
+    d_upos = g.c_pos[..., None] * h[:, None, :]  # (B, C, d)
+    d_uneg = g.c_neg[..., None] * h[:, None, None, :]  # (B, C, n, d)
+    syn0 = syn0.at[centers].add(g.d_center.astype(syn0.dtype))
+    syn1 = syn1.at[contexts.reshape(-1)].add(
+        d_upos.reshape(B * C, -1).astype(syn1.dtype)
+    )
+    syn1 = syn1.at[negs.reshape(-1)].add(
+        d_uneg.reshape(B * C * num_negatives, -1).astype(syn1.dtype)
+    )
+    return syn0, syn1, g.loss
+
+
+def sgns_loss(
+    syn0: jax.Array,
+    syn1: jax.Array,
+    prob: jax.Array,
+    alias: jax.Array,
+    centers: jax.Array,
+    contexts: jax.Array,
+    mask: jax.Array,
+    key: jax.Array,
+    num_negatives: int,
+) -> jax.Array:
+    """Forward-only masked-mean SGNS loss (the jittable inference/eval fn)."""
+    B, C = contexts.shape
+    negs = sample_negatives(key, prob, alias, (B, C, num_negatives))
+    h = syn0[centers].astype(jnp.float32)
+    u_pos = syn1[contexts].astype(jnp.float32)
+    u_neg = syn1[negs].astype(jnp.float32)
+    nmask = negative_mask(negs, contexts, mask)
+    g = sgns_grads(h, u_pos, u_neg, mask, nmask, jnp.float32(1.0))
+    return g.loss

@@ -1,0 +1,486 @@
+"""The sharded embedding engine — the parameter-server replacement.
+
+This is the in-tree, TPU-native re-implementation of the external Glint
+fork's capability surface (SURVEY.md §2.2): the ``BigWord2VecMatrix`` whose
+vocab rows are sharded 1/n per server (README.md:69) becomes two jax arrays
+sharded ``P("model", None)`` over a device mesh, and every server-side op
+maps to a jitted SPMD function:
+
+  Glint op (call site)                     -> engine method
+  ------------------------------------------------------------------
+  dotprod + adjust (mllib:421,425)         -> train_step (one fused op)
+  pull (mllib:514,539,639,652; ml:353)     -> pull
+  pullAverage (ml:453)                     -> pull_average
+  norms (mllib:486)                        -> norms
+  multiply (mllib:598)                     -> multiply (+ top_k_cosine,
+                                              replacing the O(vocab) driver
+                                              scan at mllib:601-617)
+  save (mllib:494) / loadWord2vecMatrix    -> save / load
+  destroy / cols (mllib:665,473)           -> destroy / dim
+
+Communication design: a ``psum`` over the "model" axis replaces the
+client<->server pull round-trip (each shard contributes its owned rows,
+zeros elsewhere); an ``all_gather`` over the "data" axis replaces the
+async gradient push — per-step traffic stays O(batch * d), never O(vocab),
+preserving the CIKM'16 network-efficiency property in spirit (SURVEY.md
+§3.1). There is no message-size ceiling, so the reference's
+``GranularBigWord2VecMatrix`` splitter (mllib:83-85,362) has no analogue;
+request batching survives only as ``max_query_rows`` chunking in the model
+layer to bound HBM spikes.
+
+Negative sampling is mesh-invariant: every rank draws the *full* batch's
+negatives from the shared per-step key and slices its data-shard — the
+same (key -> negatives) contract the reference implements by broadcasting
+a seed to all servers (``dotprod(..., seed)``, mllib:420-421) — so results
+are bitwise-independent of mesh shape up to float reduction order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from glint_word2vec_tpu.corpus.alias import build_unigram_alias
+from glint_word2vec_tpu.ops import sgns
+from glint_word2vec_tpu.ops.sampling import sample_negatives
+from glint_word2vec_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    pad_to_multiple,
+    table_sharding,
+)
+
+
+def _pull_rows(table_l, idx, start, rows_per_shard):
+    """Gather global rows from a shard-local table: contribute owned rows,
+    zeros elsewhere, then psum over the model axis. The TPU analogue of the
+    servers each answering a pull with their slice (SURVEY.md §2.2 pull)."""
+    loc = idx - start
+    own = (loc >= 0) & (loc < rows_per_shard)
+    rows = jnp.where(
+        own[:, None],
+        table_l[jnp.clip(loc, 0, rows_per_shard - 1)].astype(jnp.float32),
+        0.0,
+    )
+    return lax.psum(rows, MODEL_AXIS)
+
+
+def _scatter_rows(table_l, idx, upd, start, rows_per_shard):
+    """Apply global rank-1 updates to the owned slice of a sharded table
+    (the servers' half of ``adjust``, SURVEY.md §2.2). Disowned updates are
+    zeroed and land harmlessly on a clipped row."""
+    loc = idx - start
+    own = (loc >= 0) & (loc < rows_per_shard)
+    upd = jnp.where(own[:, None], upd, 0.0)
+    return table_l.at[jnp.clip(loc, 0, rows_per_shard - 1)].add(
+        upd.astype(table_l.dtype)
+    )
+
+
+class EmbeddingEngine:
+    """Owns the sharded syn0/syn1 tables and all device-side ops.
+
+    Args:
+      mesh: a ("data", "model") mesh from parallel.mesh.make_mesh.
+      vocab_size: unpadded vocabulary size.
+      dim: embedding dimension (reference ``vectorSize``; ``matrix.cols``).
+      counts: per-word corpus counts driving the noise distribution
+        (the broadcast ``bcVocabCns`` the servers build their unigram table
+        from, mllib:355; SURVEY.md §2.2 Word2VecArguments).
+      num_negatives / unigram_power / unigram_table_size: noise geometry.
+      seed: table-init seed.
+      dtype: table dtype (float32 | bfloat16); compute is always float32.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        vocab_size: int,
+        dim: int,
+        counts: np.ndarray,
+        *,
+        num_negatives: int = 5,
+        unigram_power: float = 0.75,
+        unigram_table_size: Optional[int] = None,
+        seed: int = 1,
+        dtype: str = "float32",
+    ):
+        if vocab_size <= 0 or dim <= 0:
+            raise ValueError("vocab_size and dim must be > 0")
+        if counts.shape != (vocab_size,):
+            raise ValueError("counts must have shape (vocab_size,)")
+        self.mesh = mesh
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.num_negatives = int(num_negatives)
+        self.unigram_power = float(unigram_power)
+        self.unigram_table_size = unigram_table_size
+        self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        self.num_data = mesh.shape[DATA_AXIS]
+        self.num_model = mesh.shape[MODEL_AXIS]
+        self.padded_vocab = pad_to_multiple(self.vocab_size, self.num_model)
+        self.rows_per_shard = self.padded_vocab // self.num_model
+
+        # Noise distribution over the *unpadded* vocab — draws are therefore
+        # identical for every mesh shape (padding never enters sampling),
+        # and padded rows can never be drawn as negatives.
+        self._counts = np.asarray(counts, dtype=np.int64).copy()
+        table = build_unigram_alias(
+            self._counts, power=unigram_power, table_size=unigram_table_size
+        )
+        repl = NamedSharding(mesh, P())
+        self._prob = jax.device_put(jnp.asarray(table.prob), repl)
+        self._alias = jax.device_put(jnp.asarray(table.alias), repl)
+
+        # Initialize tables directly sharded on-device (no host round-trip):
+        # syn0 ~ U[-0.5/d, 0.5/d), syn1 = 0 (word2vec standard, ops/sgns.py).
+        # Randoms are drawn for the unpadded rows only, then zero-padded, so
+        # initial values are also mesh-shape-invariant.
+        tsh = table_sharding(mesh)
+        V, Vp, d = self.vocab_size, self.padded_vocab, self.dim
+
+        def _init(key):
+            s0, s1 = sgns.init_tables(key, V, d, self._dtype)
+            pad = ((0, Vp - V), (0, 0))
+            return jnp.pad(s0, pad), jnp.pad(s1, pad)
+
+        self.syn0, self.syn1 = jax.jit(_init, out_shardings=(tsh, tsh))(
+            jax.random.PRNGKey(seed)
+        )
+        self._build_jitted_fns()
+
+    # ------------------------------------------------------------------
+    # Jitted SPMD program construction
+    # ------------------------------------------------------------------
+
+    def _shard_map(self, f, in_specs, out_specs):
+        try:
+            return shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        except TypeError:  # older jax spells the flag check_rep
+            return shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
+
+    def _build_jitted_fns(self) -> None:
+        mesh = self.mesh
+        Vs = self.rows_per_shard
+        n = self.num_negatives
+        tspec = P(MODEL_AXIS, None)
+        rep = P()
+
+        def local_train_step(syn0_l, syn1_l, prob, alias, centers, contexts,
+                             mask, key, alpha):
+            # centers/contexts/mask arrive data-sharded: (Bl,), (Bl, C).
+            Bl, C = contexts.shape
+            start = lax.axis_index(MODEL_AXIS) * Vs
+            drank = lax.axis_index(DATA_AXIS)
+            # Mesh-invariant negatives: draw for the full global batch from
+            # the shared key, slice this rank's rows (see module docstring).
+            B = Bl * self.num_data
+            negs_full = sample_negatives(key, prob, alias, (B, C, n))
+            negs = lax.dynamic_slice_in_dim(negs_full, drank * Bl, Bl, axis=0)
+
+            h = _pull_rows(syn0_l, centers, start, Vs)
+            u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs)
+            u_pos = u_pos.reshape(Bl, C, -1)
+            u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs)
+            u_neg = u_neg.reshape(Bl, C, n, -1)
+            nmask = sgns.negative_mask(negs, contexts, mask)
+            g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
+                                alpha.astype(jnp.float32))
+
+            # Rank-1 update payloads (the reference's gPlus/gMinus scalars
+            # expanded client-side, mllib:422-425).
+            d_upos = g.c_pos[..., None] * h[:, None, :]
+            d_uneg = g.c_neg[..., None] * h[:, None, None, :]
+            ids1 = jnp.concatenate([contexts.reshape(-1), negs.reshape(-1)])
+            upd1 = jnp.concatenate(
+                [d_upos.reshape(Bl * C, -1), d_uneg.reshape(Bl * C * n, -1)]
+            )
+            # Exchange updates across the data axis, then each shard applies
+            # the slice it owns.
+            ids0_g = lax.all_gather(centers, DATA_AXIS, tiled=True)
+            upd0_g = lax.all_gather(g.d_center, DATA_AXIS, tiled=True)
+            ids1_g = lax.all_gather(ids1, DATA_AXIS, tiled=True)
+            upd1_g = lax.all_gather(upd1, DATA_AXIS, tiled=True)
+            syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs)
+            syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs)
+
+            # Masked-mean loss over the global batch.
+            denom = mask.sum()
+            loss_sum = g.loss * jnp.maximum(denom, 1.0)
+            loss = lax.psum(loss_sum, DATA_AXIS) / jnp.maximum(
+                lax.psum(denom, DATA_AXIS), 1.0
+            )
+            return syn0_l, syn1_l, loss
+
+        self._train_step = jax.jit(
+            self._shard_map(
+                local_train_step,
+                in_specs=(tspec, tspec, rep, rep, P(DATA_AXIS),
+                          P(DATA_AXIS, None), P(DATA_AXIS, None), rep, rep),
+                out_specs=(tspec, tspec, rep),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        def local_pull(table_l, idx):
+            start = lax.axis_index(MODEL_AXIS) * Vs
+            return _pull_rows(table_l, idx, start, Vs)
+
+        self._pull = jax.jit(
+            self._shard_map(local_pull, in_specs=(tspec, rep), out_specs=rep)
+        )
+
+        def local_pull_average(table_l, idx, m):
+            # idx/m: (S, L) padded sentence word-indices + validity mask.
+            S, L = idx.shape
+            start = lax.axis_index(MODEL_AXIS) * Vs
+            rows = _pull_rows(table_l, idx.reshape(-1), start, Vs)
+            rows = rows.reshape(S, L, -1) * m[..., None]
+            return rows.sum(axis=1) / jnp.maximum(
+                m.sum(axis=1)[:, None], 1.0
+            )
+
+        self._pull_average = jax.jit(
+            self._shard_map(
+                local_pull_average, in_specs=(tspec, rep, rep), out_specs=rep
+            )
+        )
+
+        def local_norms(table_l):
+            # Shard-local, no communication: output stays model-sharded.
+            return jnp.sqrt(
+                (table_l.astype(jnp.float32) ** 2).sum(axis=1)
+            )
+
+        self._norms = jax.jit(
+            self._shard_map(local_norms, in_specs=(tspec,), out_specs=P(MODEL_AXIS))
+        )
+
+        def local_multiply(table_l, v):
+            # Distributed matvec: each shard scores its own rows (the TP
+            # matvec noted in SURVEY.md §2.3); output model-sharded.
+            return table_l.astype(jnp.float32) @ v
+
+        self._multiply = jax.jit(
+            self._shard_map(
+                local_multiply, in_specs=(tspec, rep), out_specs=P(MODEL_AXIS)
+            )
+        )
+
+        def make_topk(k: int):
+            def local_topk(table_l, v, norms_l):
+                # Cosine top-k without materializing all V scores on one
+                # device: local top-k per shard, all_gather the M*k
+                # candidates, merge. Replaces the reference's full-vocab
+                # driver-side scan (mllib:601-617).
+                start = lax.axis_index(MODEL_AXIS) * Vs
+                kk = min(k, Vs)
+                scores = table_l.astype(jnp.float32) @ v
+                # Zero-norm rows (incl. vocab padding) must never outrank a
+                # real word with negative cosine: score them -inf (the
+                # reference's zero-norm guard at mllib:603-609 only had to
+                # avoid a 0/0).
+                safe = jnp.where(norms_l > 0, norms_l, 1.0)
+                cos = jnp.where(norms_l > 0, scores / safe, -jnp.inf)
+                val, idx = lax.top_k(cos, kk)
+                cand_val = lax.all_gather(val, MODEL_AXIS, tiled=True)
+                cand_idx = lax.all_gather(idx + start, MODEL_AXIS, tiled=True)
+                mval, mpos = lax.top_k(cand_val, min(k, cand_val.shape[0]))
+                return mval, cand_idx[mpos]
+
+            return jax.jit(
+                self._shard_map(
+                    local_topk,
+                    in_specs=(tspec, rep, P(MODEL_AXIS)),
+                    out_specs=(rep, rep),
+                )
+            )
+
+        self._topk_cache: dict = {}
+        self._make_topk = make_topk
+        # Lazy norms cache, invalidated by any table mutation — the engine-
+        # side analogue of the reference's cached ``wordVecNorms``
+        # (mllib:486).
+        self._norms_cache = None
+
+    # ------------------------------------------------------------------
+    # Training
+    # ------------------------------------------------------------------
+
+    def train_step(self, centers, contexts, mask, key, alpha) -> float:
+        """One synchronous SGNS minibatch update; returns the batch loss.
+
+        The fused equivalent of one ``dotprod`` -> gradient-scale ->
+        ``adjust`` round trip (mllib:421-425). Batch rows must be divisible
+        by the data-axis size.
+        """
+        B = centers.shape[0]
+        if B % self.num_data:
+            raise ValueError(
+                f"batch size {B} not divisible by data axis {self.num_data}"
+            )
+        self.syn0, self.syn1, loss = self._train_step(
+            self.syn0, self.syn1, self._prob, self._alias,
+            jnp.asarray(centers), jnp.asarray(contexts),
+            jnp.asarray(mask, dtype=jnp.float32), key,
+            jnp.float32(alpha),
+        )
+        self._norms_cache = None
+        return loss
+
+    # ------------------------------------------------------------------
+    # Serving ops (the BigWord2VecMatrix query surface)
+    # ------------------------------------------------------------------
+
+    def pull(self, indices) -> jax.Array:
+        """Gather syn0 rows by global index (Glint ``pull``, mllib:514)."""
+        return self._pull(self.syn0, jnp.asarray(indices, dtype=jnp.int32))
+
+    def pull_average(self, sentence_indices, mask) -> jax.Array:
+        """Mean of syn0 rows per padded index-set row (Glint ``pullAverage``,
+        ml:453): sentence embedding computed device-side; only S*d floats
+        ever leave the device. All-masked rows yield zero vectors (the
+        reference's empty-sentence average)."""
+        return self._pull_average(
+            self.syn0,
+            jnp.asarray(sentence_indices, dtype=jnp.int32),
+            jnp.asarray(mask, dtype=jnp.float32),
+        )
+
+    def norms(self) -> jax.Array:
+        """Per-row Euclidean norms of syn0, computed shard-local (Glint
+        ``norms``, mllib:486), cached until the next table mutation.
+        Returns the padded-vocab array; rows past vocab_size are zero."""
+        if self._norms_cache is None:
+            self._norms_cache = self._norms(self.syn0)
+        return self._norms_cache
+
+    def multiply(self, vec) -> jax.Array:
+        """Distributed matvec syn0 @ vec (Glint ``multiply``, mllib:598)."""
+        v = jnp.asarray(vec, dtype=jnp.float32)
+        if v.shape != (self.dim,):
+            raise ValueError(f"vec must have shape ({self.dim},)")
+        return self._multiply(self.syn0, v)
+
+    def top_k_cosine(self, vec, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """On-device distributed top-k by cosine similarity against syn0.
+
+        Returns (similarities, indices), padded rows excluded by their zero
+        norm. The query is normalized here (the reference normalizes with
+        BLAS snrm2/sscal before ``multiply``, mllib:593-595)."""
+        if not 0 < k <= self.padded_vocab:
+            raise ValueError(f"k must be in [1, {self.padded_vocab}]")
+        v = np.asarray(vec, dtype=np.float32)
+        nrm = float(np.linalg.norm(v))
+        if nrm > 0:
+            v = v / nrm
+        if k not in self._topk_cache:
+            self._topk_cache[k] = self._make_topk(k)
+        val, idx = self._topk_cache[k](
+            self.syn0, jnp.asarray(v), self.norms()
+        )
+        return np.asarray(val), np.asarray(idx)
+
+    # ------------------------------------------------------------------
+    # Persistence / lifecycle
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Write both matrices + engine metadata (Glint ``matrix.save``,
+        mllib:494 — servers flushing shards to HDFS becomes device_get ->
+        npy). Unpadded rows only; a future-mesh load re-pads freely."""
+        os.makedirs(path, exist_ok=True)
+        syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.vocab_size]
+        syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.vocab_size]
+        np.save(os.path.join(path, "syn0.npy"), syn0)
+        np.save(os.path.join(path, "syn1.npy"), syn1)
+        counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
+        np.save(os.path.join(path, "counts.npy"), counts)
+        meta = {
+            "vocab_size": self.vocab_size,
+            "dim": self.dim,
+            "num_negatives": self.num_negatives,
+            "unigram_power": self.unigram_power,
+            "unigram_table_size": self.unigram_table_size,
+            "dtype": "bfloat16" if self._dtype == jnp.bfloat16 else "float32",
+        }
+        with open(os.path.join(path, "engine.json"), "w") as f:
+            json.dump(meta, f)
+
+    def _counts_unpadded(self) -> np.ndarray:
+        # Recover counts from the alias table is lossy; engines keep them.
+        return self._counts
+
+    @classmethod
+    def load(cls, path: str, mesh, **overrides) -> "EmbeddingEngine":
+        """Rebuild an engine from :meth:`save` output onto any mesh shape —
+        the analogue of re-homing a saved model onto a different PS cluster
+        (mllib:696-725, ml:584-586)."""
+        with open(os.path.join(path, "engine.json")) as f:
+            meta = json.load(f)
+        counts = np.load(os.path.join(path, "counts.npy"))
+        eng = cls(
+            mesh,
+            meta["vocab_size"],
+            meta["dim"],
+            counts,
+            num_negatives=overrides.get("num_negatives", meta["num_negatives"]),
+            unigram_power=overrides.get(
+                "unigram_power", meta.get("unigram_power", 0.75)
+            ),
+            unigram_table_size=overrides.get(
+                "unigram_table_size", meta.get("unigram_table_size")
+            ),
+            dtype=overrides.get("dtype", meta["dtype"]),
+        )
+        syn0 = np.load(os.path.join(path, "syn0.npy"))
+        syn1 = np.load(os.path.join(path, "syn1.npy"))
+        eng.set_tables(syn0, syn1)
+        return eng
+
+    def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:
+        """Install host table values (unpadded), re-padding and re-sharding."""
+        if syn0.shape != (self.vocab_size, self.dim):
+            raise ValueError("syn0 shape mismatch")
+        if syn1.shape != (self.vocab_size, self.dim):
+            raise ValueError("syn1 shape mismatch")
+        pad = self.padded_vocab - self.vocab_size
+        tsh = table_sharding(self.mesh)
+        full0 = np.pad(syn0, ((0, pad), (0, 0))).astype(np.float32)
+        full1 = np.pad(syn1, ((0, pad), (0, 0))).astype(np.float32)
+        self.syn0 = jax.device_put(jnp.asarray(full0, dtype=self._dtype), tsh)
+        self.syn1 = jax.device_put(jnp.asarray(full1, dtype=self._dtype), tsh)
+        self._norms_cache = None
+
+    def destroy(self) -> None:
+        """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
+        for a in (self.syn0, self.syn1, self._prob, self._alias):
+            try:
+                a.delete()
+            except Exception:
+                pass
+        self.syn0 = self.syn1 = self._prob = self._alias = None
+        self._norms_cache = None
+
+    @property
+    def cols(self) -> int:
+        """Column count == vector size (Glint ``matrix.cols``, mllib:473)."""
+        return self.dim

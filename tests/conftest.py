@@ -8,19 +8,29 @@ Must run before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pre-sets a TPU platform: unit tests
+# must never grab (or wait on) the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+# Some environments pre-register a remote TPU backend at interpreter start
+# and force jax.config jax_platforms to prefer it (overriding the env var,
+# which is only read as the config default). Point the config back at CPU
+# before any backend initializes, or every jax.devices() call blocks on the
+# remote tunnel.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
 
-@pytest.fixture(scope="session")
-def tiny_corpus():
+def _make_tiny_corpus():
     """Deterministic synthetic corpus with learnable structure.
 
     Mirrors the role of the reference's German-Wikipedia country/capital
@@ -38,21 +48,34 @@ def tiny_corpus():
         ("italy", "rome"),
         ("poland", "warsaw"),
     ]
-    filler = [f"w{i}" for i in range(50)]
+    # Pair-specific theme words give each (country, capital) pair shared
+    # contexts — the second-order co-occurrence that makes a capital
+    # distributionally similar to its country in real text.
+    theme = {c: [f"{c}_t{j}" for j in range(4)] for c, _ in pairs}
+    filler = [f"w{i}" for i in range(40)]
     sentences = []
-    for _ in range(3000):
+    for _ in range(4000):
         country, capital = pairs[rng.integers(len(pairs))]
-        style = rng.integers(3)
-        noise = list(rng.choice(filler, size=3))
+        th = list(rng.choice(theme[country], size=2))
+        noise = list(rng.choice(filler, size=2))
+        style = rng.integers(4)
         if style == 0:
-            s = [capital, "is", "the", "capital", "of", country] + noise
+            s = [capital, "is", "the", "capital", "of", country] + th
         elif style == 1:
-            s = noise[:2] + [country, "capital", "city", capital] + noise[2:]
+            s = [th[0], country, "capital", "city", capital, th[1]] + noise
+        elif style == 2:
+            s = [country, "has", "capital", capital] + th + noise
         else:
-            s = [country, "has", "capital", capital] + noise
+            x = country if rng.random() < 0.5 else capital
+            s = [x, "famous", "for"] + th + noise
         sentences.append(s)
     # Pure-filler sentences so filler words reach min_count reliably.
-    for _ in range(500):
+    for _ in range(600):
         sentences.append(list(rng.choice(filler, size=8)))
     rng.shuffle(sentences)
-    return [list(s) for s in sentences]
+    return [[str(w) for w in s] for s in sentences]
+
+
+@pytest.fixture(scope="session")
+def tiny_corpus():
+    return _make_tiny_corpus()

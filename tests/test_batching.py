@@ -132,6 +132,16 @@ def test_batcher_epoch_determinism_and_epoch_variation():
     )
 
 
+def test_batcher_words_done_counts_pre_subsampling():
+    # The LR-anneal denominator is pre-subsampling train_words_count, so
+    # words_done must count raw words or the schedule never completes.
+    v = build_vocab([["a"] * 100], min_count=1)
+    sents = [np.zeros(100, np.int32)]
+    b = SkipGramBatcher(sents, v, 8, 2, subsample_ratio=1e-6, seed=1)
+    list(b.epoch(0))
+    assert b.words_done == 100  # even though nearly all were subsampled away
+
+
 def test_batcher_validates_args():
     v = _vocab()
     with pytest.raises(ValueError):

@@ -1,0 +1,204 @@
+"""Sharded embedding-engine tests on the virtual 8-device CPU mesh.
+
+This is the distributed-correctness suite the reference runs as a Docker
+pseudo-cluster integration test (SURVEY.md §4); here every Glint-op
+equivalent is checked for exactness and for mesh-shape invariance.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus import build_unigram_alias
+from glint_word2vec_tpu.ops import sgns
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+V, D = 50, 16  # deliberately not divisible by 8: exercises padding
+
+
+def _mk_engine(num_data, num_model, seed=3):
+    counts = np.arange(V, 0, -1).astype(np.int64) * 10
+    mesh = make_mesh(num_data, num_model)
+    return EmbeddingEngine(
+        mesh, V, D, counts, num_negatives=4, seed=seed
+    )
+
+
+def _batch(B=16, C=5, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.integers(0, V, B).astype(np.int32)
+    contexts = rng.integers(0, V, (B, C)).astype(np.int32)
+    mask = (rng.random((B, C)) < 0.8).astype(np.float32)
+    contexts = np.where(mask > 0, contexts, 0)
+    return centers, contexts, mask
+
+
+def test_mesh_construction_variants():
+    assert make_mesh(2, 4).shape == {"data": 2, "model": 4}
+    assert make_mesh(num_model=8).shape == {"data": 1, "model": 8}
+    assert make_mesh(num_data=8).shape == {"data": 8, "model": 1}
+    with pytest.raises(ValueError):
+        make_mesh(3, 3)
+
+
+def test_padding_geometry():
+    eng = _mk_engine(2, 4)
+    assert eng.padded_vocab == 52  # 50 -> multiple of 4
+    assert eng.rows_per_shard == 13
+    assert eng.cols == D
+
+
+def test_pull_matches_host_tables():
+    eng = _mk_engine(1, 8)
+    syn0 = np.asarray(eng.syn0)[:V]
+    idx = np.array([0, 7, 49, 3, 3], np.int32)
+    rows = np.asarray(eng.pull(idx))
+    np.testing.assert_allclose(rows, syn0[idx], rtol=1e-6)
+
+
+def test_norms_and_multiply_match_host():
+    eng = _mk_engine(2, 4)
+    syn0 = np.asarray(eng.syn0, dtype=np.float32)
+    nrm = np.asarray(eng.norms())
+    np.testing.assert_allclose(nrm, np.linalg.norm(syn0, axis=1), rtol=1e-5)
+    v = np.random.default_rng(0).normal(size=D).astype(np.float32)
+    scores = np.asarray(eng.multiply(v))
+    np.testing.assert_allclose(scores, syn0 @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_pull_average_masked_mean_and_empty_row():
+    eng = _mk_engine(1, 8)
+    syn0 = np.asarray(eng.syn0)
+    idx = np.array([[1, 2, 0], [5, 0, 0], [0, 0, 0]], np.int32)
+    m = np.array([[1, 1, 0], [1, 0, 0], [0, 0, 0]], np.float32)
+    out = np.asarray(eng.pull_average(idx, m))
+    np.testing.assert_allclose(out[0], (syn0[1] + syn0[2]) / 2, rtol=1e-5)
+    np.testing.assert_allclose(out[1], syn0[5], rtol=1e-6)
+    # Empty sentence -> zero vector (reference empty-average semantics).
+    np.testing.assert_array_equal(out[2], np.zeros(D, np.float32))
+
+
+def test_top_k_cosine_matches_host():
+    eng = _mk_engine(2, 4)
+    syn0 = np.asarray(eng.syn0, dtype=np.float32)[:V]
+    q = syn0[17].copy()
+    sims, idx = eng.top_k_cosine(q, 5)
+    nrm = np.linalg.norm(syn0, axis=1)
+    qn = q / np.linalg.norm(q)
+    cos = (syn0 @ qn) / np.where(nrm > 0, nrm, 1.0)
+    exp_idx = np.argsort(-cos)[:5]
+    assert idx[0] == 17  # the word itself ranks first
+    np.testing.assert_array_equal(np.sort(idx), np.sort(exp_idx))
+    np.testing.assert_allclose(sims, cos[exp_idx], rtol=1e-5)
+
+
+def test_train_step_matches_single_device_reference():
+    # The sharded step on a (2,4) mesh must equal ops.sgns.train_step run
+    # on the same (padded) tables — same key => same negatives (the
+    # mesh-invariant sampling contract).
+    eng = _mk_engine(2, 4)
+    syn0_before = np.asarray(eng.syn0, dtype=np.float32)
+    syn1_before = np.asarray(eng.syn1, dtype=np.float32)
+    prob = np.asarray(eng._prob)
+    alias = np.asarray(eng._alias)
+    centers, contexts, mask = _batch(B=16, C=5)
+    key = jax.random.PRNGKey(11)
+    alpha = 0.03
+
+    loss = eng.train_step(centers, contexts, mask, key, alpha)
+
+    exp0, exp1, exp_loss = sgns.train_step(
+        jnp.asarray(syn0_before), jnp.asarray(syn1_before),
+        jnp.asarray(prob), jnp.asarray(alias),
+        jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(mask),
+        key, jnp.float32(alpha), num_negatives=4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(eng.syn0), np.asarray(exp0), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(eng.syn1), np.asarray(exp1), rtol=1e-5, atol=1e-6
+    )
+    assert float(loss) == pytest.approx(float(exp_loss), rel=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (8, 1), (1, 8), (4, 2)])
+def test_train_step_mesh_invariance(shape):
+    # Identical seeds and batches must produce identical tables on every
+    # mesh shape (up to float reduction order).
+    ref = _mk_engine(2, 4)
+    eng = _mk_engine(*shape)
+    np.testing.assert_array_equal(
+        np.asarray(ref.syn0, np.float32)[:V], np.asarray(eng.syn0, np.float32)[:V]
+    )
+    centers, contexts, mask = _batch(B=16, C=5, seed=4)
+    key = jax.random.PRNGKey(5)
+    l_ref = ref.train_step(centers, contexts, mask, key, 0.05)
+    l_eng = eng.train_step(centers, contexts, mask, key, 0.05)
+    assert float(l_ref) == pytest.approx(float(l_eng), rel=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ref.syn0, np.float32)[:V],
+        np.asarray(eng.syn0, np.float32)[:V],
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.syn1, np.float32)[:V],
+        np.asarray(eng.syn1, np.float32)[:V],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_train_step_batch_divisibility_guard():
+    eng = _mk_engine(2, 4)
+    centers, contexts, mask = _batch(B=15)
+    with pytest.raises(ValueError, match="divisible"):
+        eng.train_step(centers, contexts, mask, jax.random.PRNGKey(0), 0.01)
+
+
+def test_save_load_roundtrip_across_mesh_shapes(tmp_path):
+    eng = _mk_engine(2, 4)
+    centers, contexts, mask = _batch()
+    eng.train_step(centers, contexts, mask, jax.random.PRNGKey(0), 0.05)
+    syn0 = np.asarray(eng.syn0, np.float32)[:V]
+    path = str(tmp_path / "m")
+    eng.save(path)
+    # Re-home onto a different "cluster" shape (mllib:696-725 analogue).
+    eng2 = EmbeddingEngine.load(path, make_mesh(1, 8))
+    np.testing.assert_allclose(
+        np.asarray(eng2.syn0, np.float32)[:V], syn0, rtol=1e-6
+    )
+    assert eng2.vocab_size == V and eng2.dim == D
+    # Loaded engine keeps training.
+    eng2.train_step(centers, contexts, mask, jax.random.PRNGKey(1), 0.05)
+
+
+def test_top_k_never_returns_padded_rows():
+    # Padded vocab rows (zero norm) score -inf, so even a k covering most
+    # of the vocab returns only real indices with finite sims.
+    eng = _mk_engine(1, 8)  # padded_vocab 56 > V=50
+    sims, idx = eng.top_k_cosine(np.ones(D, np.float32), V)
+    assert np.all(idx < V)
+    assert np.all(np.isfinite(sims))
+
+
+def test_save_load_preserves_noise_geometry(tmp_path):
+    counts = np.arange(V, 0, -1).astype(np.int64) * 10
+    eng = EmbeddingEngine(
+        make_mesh(1, 8), V, D, counts, num_negatives=4,
+        unigram_power=0.5, seed=3,
+    )
+    path = str(tmp_path / "m")
+    eng.save(path)
+    eng2 = EmbeddingEngine.load(path, make_mesh(2, 4))
+    assert eng2.unigram_power == 0.5
+    np.testing.assert_array_equal(np.asarray(eng._prob), np.asarray(eng2._prob))
+
+
+def test_destroy_frees_tables():
+    eng = _mk_engine(1, 8)
+    eng.destroy()
+    assert eng.syn0 is None and eng.syn1 is None
