@@ -1,0 +1,144 @@
+"""Command-line interface: train / query / inspect without writing code.
+
+The reference's operational entry points are spark-submit invocations — the
+trainer app and the standalone ``glint.Main`` PS-cluster launcher
+(README.md:45-57, build.sbt:42-59, SURVEY.md §3.5). On TPU there is no
+separate server process to launch (the "cluster" is the device mesh inside
+this process), so the CLI collapses to:
+
+  python -m glint_word2vec_tpu.cli train   --corpus c.txt --output m/ [...]
+  python -m glint_word2vec_tpu.cli synonyms --model m/ --word w [-n 10]
+  python -m glint_word2vec_tpu.cli analogy  --model m/ --positive a b --negative c
+  python -m glint_word2vec_tpu.cli transform --model m/ --sentence "w1 w2 w3"
+  python -m glint_word2vec_tpu.cli info     --model m/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+
+def _add_train(sub):
+    p = sub.add_parser("train", help="train a model from a text corpus")
+    p.add_argument("--corpus", required=True, help="text file, one sentence per line")
+    p.add_argument("--output", required=True, help="model output directory")
+    p.add_argument("--lowercase", action="store_true")
+    p.add_argument("--vector-size", type=int, default=100)
+    p.add_argument("--window", type=int, default=5)
+    p.add_argument("--step-size", type=float, default=0.01875)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--negatives", type=int, default=5)
+    p.add_argument("--subsample-ratio", type=float, default=0.0)
+    p.add_argument("--min-count", type=int, default=5)
+    p.add_argument("--iterations", type=int, default=1)
+    p.add_argument("--max-sentence-length", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--num-partitions", type=int, default=1,
+                   help="data-parallel mesh axis (reference numPartitions)")
+    p.add_argument("--num-shards", type=int, default=1,
+                   help="model-parallel mesh axis (reference numParameterServers)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable epoch-granular checkpoint/resume")
+    p.add_argument("--metrics-out", default=None,
+                   help="write training metrics JSON here")
+
+
+def _add_query(sub):
+    p = sub.add_parser("synonyms", help="nearest neighbors of a word")
+    p.add_argument("--model", required=True)
+    p.add_argument("--word", required=True)
+    p.add_argument("-n", "--num", type=int, default=10)
+
+    p = sub.add_parser("analogy", help="a is to b as c is to ?")
+    p.add_argument("--model", required=True)
+    p.add_argument("--positive", nargs="+", required=True)
+    p.add_argument("--negative", nargs="+", default=[])
+    p.add_argument("-n", "--num", type=int, default=10)
+
+    p = sub.add_parser("transform", help="embed a sentence (mean of word vectors)")
+    p.add_argument("--model", required=True)
+    p.add_argument("--sentence", required=True, help="whitespace-tokenized")
+
+    p = sub.add_parser("info", help="model metadata")
+    p.add_argument("--model", required=True)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
+    )
+    parser = argparse.ArgumentParser(prog="glint_word2vec_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _add_train(sub)
+    _add_query(sub)
+    args = parser.parse_args(argv)
+    try:
+        return _run(args)
+    except (KeyError, ValueError, FileNotFoundError) as e:
+        # Expected user errors (OOV word, bad path, bad params): one clean
+        # line, no traceback.
+        msg = e.args[0] if e.args else str(e)
+        print(f"error: {msg}", file=sys.stderr)
+        return 1
+
+
+def _run(args) -> int:
+
+    from glint_word2vec_tpu import Word2Vec, Word2VecModel
+    from glint_word2vec_tpu.corpus.vocab import iter_text_file
+
+    if args.cmd == "train":
+        sentences = list(iter_text_file(args.corpus, lowercase=args.lowercase))
+        w2v = Word2Vec(
+            vector_size=args.vector_size,
+            window=args.window,
+            step_size=args.step_size,
+            batch_size=args.batch_size,
+            num_negatives=args.negatives,
+            subsample_ratio=args.subsample_ratio,
+            min_count=args.min_count,
+            num_iterations=args.iterations,
+            max_sentence_length=args.max_sentence_length,
+            seed=args.seed,
+            num_partitions=args.num_partitions,
+            num_shards=args.num_shards,
+            dtype=args.dtype,
+        )
+        model = w2v.fit(sentences, checkpoint_dir=args.checkpoint_dir)
+        model.save(args.output)
+        print(json.dumps({"saved": args.output, **(model.training_metrics or {})}))
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                json.dump(model.training_metrics, f)
+        return 0
+
+    model = Word2VecModel.load(args.model)
+    if args.cmd == "synonyms":
+        for w, s in model.find_synonyms(args.word, args.num):
+            print(f"{w}\t{s:.4f}")
+    elif args.cmd == "analogy":
+        for w, s in model.analogy(args.positive, args.negative, args.num):
+            print(f"{w}\t{s:.4f}")
+    elif args.cmd == "transform":
+        vec = model.transform_sentences([args.sentence.split()])[0]
+        print(json.dumps([round(float(x), 6) for x in vec]))
+    elif args.cmd == "info":
+        print(
+            json.dumps(
+                {
+                    "vocab_size": model.vocab.size,
+                    "vector_size": model.vector_size,
+                    "train_words_count": model.vocab.train_words_count,
+                    "params": json.loads(model.params.to_json()),
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

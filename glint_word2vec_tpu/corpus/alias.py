@@ -45,7 +45,13 @@ class AliasTable:
 
 
 def build_alias(weights: np.ndarray) -> AliasTable:
-    """Construct an alias table for an arbitrary nonnegative weight vector."""
+    """Construct an alias table for an arbitrary nonnegative weight vector.
+
+    Uses the native C++ builder when available (the O(V) two-pointer loop is
+    minutes of Python at 10M vocab, milliseconds in C++ — see
+    native/host_ops.cpp); both produce valid alias decompositions of the
+    same distribution.
+    """
     w = np.asarray(weights, dtype=np.float64)
     if w.ndim != 1 or w.size == 0:
         raise ValueError("weights must be a nonempty 1-D array")
@@ -54,6 +60,13 @@ def build_alias(weights: np.ndarray) -> AliasTable:
     total = w.sum()
     if total <= 0:
         raise ValueError("weights must sum to > 0")
+
+    from glint_word2vec_tpu.native import alias_build_native
+
+    native = alias_build_native(w)
+    if native is not None:
+        return AliasTable(prob=native[0], alias=native[1])
+
     n = w.size
     scaled = w * (n / total)  # mean 1.0
     prob = np.ones(n, dtype=np.float64)

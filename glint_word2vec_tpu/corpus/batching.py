@@ -28,7 +28,7 @@ context lanes (``window-1`` on the left, ``window-2`` on the right);
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable, Iterator, List, Sequence, Tuple
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -181,9 +181,109 @@ class SkipGramBatcher:
         self.shuffle = bool(shuffle)
         self.keep_prob = vocab.keep_probabilities(subsample_ratio)
         self.words_done = 0
+        # Flattened corpus view for the native epoch pass (built lazily).
+        self._flat: tuple | None = None
 
     def epoch(self, epoch_index: int) -> Iterator[Batch]:
-        """Yield every minibatch of one pass over the corpus."""
+        """Yield every minibatch of one pass over the corpus.
+
+        Uses the native C++ epoch pass (subsample + window in one sweep,
+        native/host_ops.cpp) when available; the Python path is the
+        fallback and the semantic reference. The two paths draw different
+        RNG streams, so batches are deterministic per (path, seed, epoch)
+        but not identical across paths.
+        """
+        if not self.shuffle:
+            native = self._epoch_native(epoch_index)
+            if native is not None:
+                yield from native
+                return
+        yield from self._epoch_python(epoch_index)
+
+    #: Words per native-pass block: bounds host memory to ~60 bytes/word *
+    #: this (≈250 MB) regardless of corpus size, while amortizing call
+    #: overhead. One epoch = a sequence of native calls over sentence blocks.
+    NATIVE_BLOCK_WORDS = 4_000_000
+
+    def _epoch_native(self, epoch_index: int) -> Optional[Iterator[Batch]]:
+        from glint_word2vec_tpu.native import get_lib
+
+        if get_lib() is None:
+            return None
+        if self._flat is None:
+            if self.sentences:
+                ids = np.concatenate(self.sentences).astype(np.int32)
+                lens = np.array([len(s) for s in self.sentences], np.int64)
+            else:
+                ids = np.zeros(0, np.int32)
+                lens = np.zeros(0, np.int64)
+            offsets = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            self._flat = (ids, offsets)
+        return self._native_batches(epoch_index)
+
+    def _native_batches(self, epoch_index: int) -> Iterator[Batch]:
+        from glint_word2vec_tpu.native import window_batch_epoch_native
+
+        ids, offsets = self._flat
+        kp = self.keep_prob.astype(np.float32)
+        n_sent = len(offsets) - 1
+        B = self.batch_size
+        C = context_width(self.window)
+        # Carry buffer for the partial batch spanning block boundaries.
+        buf_c = np.zeros(B, np.int32)
+        buf_x = np.zeros((B, C), np.int32)
+        buf_m = np.zeros((B, C), np.float32)
+        fill = 0
+
+        s = 0
+        block = 0
+        while s < n_sent:
+            # Grow the block until it holds ~NATIVE_BLOCK_WORDS words.
+            e = int(
+                np.searchsorted(
+                    offsets, offsets[s] + self.NATIVE_BLOCK_WORDS, side="left"
+                )
+            )
+            e = max(e, s + 1)
+            e = min(e, n_sent)
+            seed = int(
+                np.random.SeedSequence(
+                    (self.seed, epoch_index, block)
+                ).generate_state(1, np.uint64)[0]
+            )
+            out = window_batch_epoch_native(
+                ids[offsets[s] : offsets[e]],
+                offsets[s : e + 1] - offsets[s],
+                kp,
+                self.window,
+                seed,
+            )
+            centers, contexts, mask, words_done = out
+            self.words_done += int(words_done)
+            n = centers.shape[0]
+            start = 0
+            while n - start > 0:
+                take = min(B - fill, n - start)
+                buf_c[fill : fill + take] = centers[start : start + take]
+                buf_x[fill : fill + take] = contexts[start : start + take]
+                buf_m[fill : fill + take] = mask[start : start + take]
+                fill += take
+                start += take
+                if fill == B:
+                    yield Batch(
+                        buf_c.copy(), buf_x.copy(), buf_m.copy(), self.words_done
+                    )
+                    fill = 0
+            s = e
+            block += 1
+        if fill > 0:
+            buf_c[fill:] = 0
+            buf_x[fill:] = 0
+            buf_m[fill:] = 0.0
+            yield Batch(buf_c.copy(), buf_x.copy(), buf_m.copy(), self.words_done)
+
+    def _epoch_python(self, epoch_index: int) -> Iterator[Batch]:
         B, W2 = self.batch_size, context_width(self.window)
         rng = np.random.default_rng((self.seed, epoch_index))
         order = np.arange(len(self.sentences))

@@ -23,7 +23,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import time
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,6 +34,7 @@ from glint_word2vec_tpu.corpus.batching import (
     encode_sentences,
 )
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.utils.metrics import TrainingMetrics
 from glint_word2vec_tpu.utils.params import Word2VecParams
 
 logger = logging.getLogger(__name__)
@@ -124,13 +124,28 @@ class Word2Vec:
         p = self.params
         return make_mesh(p.num_partitions, p.num_shards)
 
-    def fit(self, sentences: Iterable[Sequence[str]]) -> "Word2VecModel":
+    def fit(
+        self,
+        sentences: Iterable[Sequence[str]],
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_epochs: int = 1,
+        stop_after_epochs: Optional[int] = None,
+    ) -> "Word2VecModel":
         """Train on an iterable of tokenized sentences.
 
         The full reference ``fit`` path (mllib:310-439): vocab scan ->
         encode/chunk -> per-epoch subsample+window passes -> minibatched
         SGNS with the linear LR anneal (floor ``step_size * 1e-4``,
         mllib:405-413) -> fitted model.
+
+        ``checkpoint_dir`` enables epoch-granular checkpoint/resume — a
+        capability the reference lacks entirely (SURVEY.md §5 "no checkpoint
+        mid-training"): after every ``checkpoint_every_epochs`` epochs the
+        tables + progress counters are written, and a rerun of the same fit
+        with the same directory resumes after the last completed epoch.
+        ``stop_after_epochs`` ends the run early after that many epochs
+        *this invocation* (train-in-slices operation; the LR schedule is
+        unaffected because it depends only on global progress counters).
         """
         import jax
 
@@ -175,34 +190,85 @@ class Word2Vec:
         total_words = p.num_iterations * vocab.train_words_count + 1
         base_key = jax.random.PRNGKey(p.seed)
         step = 0
-        t0 = time.time()
-        words_at_log, t_log = 0, t0
-        loss = None
-        for epoch in range(p.num_iterations):
-            for batch in batcher.epoch(epoch):
+        start_epoch = 0
+
+        state_path = (
+            os.path.join(checkpoint_dir, "train_state.json")
+            if checkpoint_dir
+            else None
+        )
+        if state_path and os.path.exists(state_path):
+            with open(state_path) as f:
+                state = json.load(f)
+            engine.set_tables(
+                np.load(os.path.join(checkpoint_dir, "ckpt", "syn0.npy")),
+                np.load(os.path.join(checkpoint_dir, "ckpt", "syn1.npy")),
+            )
+            start_epoch = state["epochs_completed"]
+            step = state["step"]
+            batcher.words_done = state["words_done"]
+            logger.info(
+                "resuming after epoch %d (step %d)", start_epoch, step
+            )
+        # Metrics count only THIS invocation's work; on resume the restored
+        # global counter must not inflate throughput numbers.
+        metrics = TrainingMetrics(base_words=batcher.words_done)
+
+        def save_checkpoint(epochs_completed: int) -> None:
+            # Atomic: tables first (tmp + rename), state.json last, so a
+            # crash mid-write can never yield a state file pointing at
+            # mismatched tables.
+            ck = os.path.join(checkpoint_dir, "ckpt")
+            os.makedirs(ck, exist_ok=True)
+            for name, table in (("syn0", engine.syn0), ("syn1", engine.syn1)):
+                tmp = os.path.join(ck, f".{name}.tmp.npy")
+                np.save(tmp, np.asarray(table, np.float32)[: vocab.size])
+                os.replace(tmp, os.path.join(ck, f"{name}.npy"))
+            tmp = state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "epochs_completed": epochs_completed,
+                        "step": step,
+                        "words_done": batcher.words_done,
+                    },
+                    f,
+                )
+            os.replace(tmp, state_path)
+
+        for epoch in range(start_epoch, p.num_iterations):
+            it = batcher.epoch(epoch)
+            while True:
+                with metrics.timing("host"):
+                    batch = next(it, None)
+                if batch is None:
+                    break
                 alpha = max(
                     p.step_size * (1 - batch.words_done / total_words),
                     p.step_size * 1e-4,
                 )
                 key = jax.random.fold_in(base_key, step)
-                loss = engine.train_step(
-                    batch.centers, batch.contexts, batch.mask, key, alpha
-                )
-                step += 1
-                if step % 200 == 0:
-                    now = time.time()
-                    wps = (batch.words_done - words_at_log) / max(now - t_log, 1e-9)
-                    logger.info(
-                        "epoch %d step %d: alpha=%.5f loss=%.4f %.0f words/s",
-                        epoch, step, alpha, float(loss), wps,
+                with metrics.timing("step"):
+                    loss = engine.train_step(
+                        batch.centers, batch.contexts, batch.mask, key, alpha
                     )
-                    words_at_log, t_log = batch.words_done, now
-        dt = time.time() - t0
-        logger.info(
-            "trained %d steps / %d words in %.1fs (%.0f words/s)",
-            step, batcher.words_done, dt, batcher.words_done / max(dt, 1e-9),
-        )
-        return Word2VecModel(vocab, engine, p)
+                step += 1
+                metrics.record_step(batch.words_done, loss=loss, alpha=alpha)
+            stopping = (
+                stop_after_epochs is not None
+                and (epoch + 1 - start_epoch) >= stop_after_epochs
+            )
+            if state_path and (
+                stopping or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
+            ):
+                save_checkpoint(epoch + 1)
+            if stopping:
+                logger.info("stopping early after epoch %d", epoch + 1)
+                break
+        logger.info("training done: %s", metrics.summary())
+        model = Word2VecModel(vocab, engine, p)
+        model.training_metrics = metrics.summary()
+        return model
 
 
 class Word2VecModel:
@@ -212,6 +278,7 @@ class Word2VecModel:
         self.vocab = vocab
         self.engine = engine
         self.params = params
+        self.training_metrics: Optional[dict] = None
 
     # ------------------------------------------------------------------
     # transform — the reference's three flavors (SURVEY.md §3.2)

@@ -1,0 +1,166 @@
+"""Native host-ops library: build-on-first-use C++ kernels via ctypes.
+
+See host_ops.cpp for what lives here and why. The library is compiled once
+into ``_host_ops.so`` next to the source (g++ -O3) and loaded with ctypes;
+every entry point has a pure-Python fallback, so the package works without a
+compiler (``GLINT_W2V_NO_NATIVE=1`` forces the fallbacks, used in tests to
+cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_ops.cpp")
+_SO = os.path.join(_HERE, "_host_ops.so")
+_STAMP = _SO + ".sha256"  # source hash the .so was built from
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _is_fresh() -> bool:
+    """A .so is usable only if built from the current source ON this machine
+    (-march=native output from another host can SIGILL); the build stamp
+    records the source hash, and a missing stamp forces a rebuild."""
+    if not os.path.exists(_SO) or not os.path.exists(_STAMP):
+        return False
+    try:
+        with open(_STAMP) as f:
+            return f.read().strip() == _src_hash()
+    except OSError:
+        return False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        with open(_STAMP, "w") as f:
+            f.write(_src_hash())
+        return True
+    except Exception as e:  # compiler missing, read-only fs, ...
+        logger.warning("native host_ops build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("GLINT_W2V_NO_NATIVE"):
+            _load_failed = True
+            return None
+        if not _is_fresh():
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            logger.warning("native host_ops load failed (%s)", e)
+            _load_failed = True
+            return None
+        lib.alias_build.restype = ctypes.c_int
+        lib.alias_build.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.window_batch_epoch.restype = ctypes.c_int64
+        lib.window_batch_epoch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return _lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def alias_build_native(weights: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native alias-table construction; None if the library is unavailable.
+    Raises ValueError for invalid weights (mirroring the Python builder)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    w = np.ascontiguousarray(weights, dtype=np.float64)
+    n = w.size
+    prob = np.empty(n, dtype=np.float32)
+    alias = np.empty(n, dtype=np.int32)
+    rc = lib.alias_build(
+        _ptr(w, ctypes.c_double), n, _ptr(prob, ctypes.c_float),
+        _ptr(alias, ctypes.c_int32),
+    )
+    if rc == 1:
+        raise ValueError("weights must be a nonempty 1-D array")
+    if rc == 2:
+        raise ValueError("weights must be finite and nonnegative")
+    if rc == 3:
+        raise ValueError("weights must sum to > 0")
+    return prob, alias
+
+
+def window_batch_epoch_native(
+    ids: np.ndarray,
+    offsets: np.ndarray,
+    keep_prob: np.ndarray,
+    window: int,
+    seed: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
+    """Run a full subsample+window epoch pass natively.
+
+    Returns (centers, contexts, mask, words_done) with exactly the kept rows,
+    or None if the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    C = max(1, 2 * int(window) - 3)
+    ids_c = np.ascontiguousarray(ids, dtype=np.int32)
+    off_c = np.ascontiguousarray(offsets, dtype=np.int64)
+    kp_c = np.ascontiguousarray(keep_prob, dtype=np.float32)
+    cap = int(ids_c.size)
+    centers = np.empty(cap, dtype=np.int32)
+    contexts = np.empty((cap, C), dtype=np.int32)
+    mask = np.empty((cap, C), dtype=np.float32)
+    words_done = ctypes.c_int64(0)
+    rows = lib.window_batch_epoch(
+        _ptr(ids_c, ctypes.c_int32), _ptr(off_c, ctypes.c_int64),
+        off_c.size - 1, _ptr(kp_c, ctypes.c_float), int(window),
+        ctypes.c_uint64(seed & (2**64 - 1)), _ptr(centers, ctypes.c_int32),
+        _ptr(contexts, ctypes.c_int32), _ptr(mask, ctypes.c_float),
+        cap, ctypes.byref(words_done),
+    )
+    if rows < 0:  # capacity == total ids, so this cannot happen
+        raise RuntimeError("window_batch_epoch capacity overflow")
+    return (
+        centers[:rows], contexts[:rows], mask[:rows], int(words_done.value)
+    )

@@ -1,0 +1,164 @@
+// host_ops: native host-side data-path kernels for glint_word2vec_tpu.
+//
+// The reference keeps its host data path on the JVM (Spark RDD passes); its
+// only native touchpoints are netlib BLAS and Aeron shared memory (SURVEY.md
+// §2.1 native-code census). In the TPU build the host data path must feed a
+// chip at millions of words/sec, so the two measured hot spots live here:
+//
+//   1. alias_build    — O(V) Walker alias-table construction (the Python
+//                       two-pointer loop takes minutes at 10M vocab).
+//   2. window_batch   — per-epoch subsample + shrunk-window context/mask
+//                       generation (the per-sentence Python/NumPy pass tops
+//                       out around 0.1M words/s; this runs >10M words/s).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// All buffers are caller-allocated NumPy arrays; nothing here allocates
+// Python objects or touches the GIL, so callers may release it.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Walker/Vose alias table over `weights[0..n)`. Outputs:
+//   prob[i]  in [0,1]  — acceptance probability for column i
+//   alias[i] in [0,n)  — fallback index for column i
+// Matches the Python reference implementation in corpus/alias.py (tested
+// for distribution equality). Returns 0 on success, nonzero on bad input.
+int alias_build(const double* weights, int64_t n, float* prob, int32_t* alias) {
+    if (n <= 0) return 1;
+    double total = 0.0;
+    for (int64_t i = 0; i < n; ++i) {
+        double w = weights[i];
+        if (!(w >= 0.0) || w != w) return 2;  // negative or NaN
+        total += w;
+    }
+    if (!(total > 0.0)) return 3;
+
+    std::vector<double> scaled(n);
+    const double k = static_cast<double>(n) / total;
+    for (int64_t i = 0; i < n; ++i) scaled[i] = weights[i] * k;
+
+    // Two-pointer partition: indices of small (<1) and large (>=1) columns.
+    std::vector<int64_t> small, large;
+    small.reserve(n);
+    large.reserve(n);
+    for (int64_t i = 0; i < n; ++i) {
+        prob[i] = 1.0f;
+        alias[i] = static_cast<int32_t>(i);
+        (scaled[i] < 1.0 ? small : large).push_back(i);
+    }
+    while (!small.empty() && !large.empty()) {
+        int64_t s = small.back();
+        small.pop_back();
+        int64_t l = large.back();
+        large.pop_back();
+        prob[s] = static_cast<float>(scaled[s]);
+        alias[s] = static_cast<int32_t>(l);
+        scaled[l] = (scaled[l] + scaled[s]) - 1.0;
+        if (scaled[l] < 1.0) small.push_back(l); else large.push_back(l);
+    }
+    return 0;
+}
+
+// xorshift128+ PRNG — fast, well-distributed, deterministic per seed.
+struct Rng {
+    uint64_t s0, s1;
+    explicit Rng(uint64_t seed) {
+        // splitmix64 seeding
+        auto next = [&seed]() {
+            seed += 0x9E3779B97f4A7C15ULL;
+            uint64_t z = seed;
+            z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+            z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+            return z ^ (z >> 31);
+        };
+        s0 = next();
+        s1 = next();
+    }
+    inline uint64_t next_u64() {
+        uint64_t x = s0;
+        const uint64_t y = s1;
+        s0 = y;
+        x ^= x << 23;
+        s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+        return s1 + y;
+    }
+    // uniform double in [0, 1)
+    inline double next_double() {
+        return (next_u64() >> 11) * (1.0 / 9007199254740992.0);
+    }
+    // uniform int in [0, m)
+    inline int64_t next_below(int64_t m) {
+        return static_cast<int64_t>(next_u64() % static_cast<uint64_t>(m));
+    }
+};
+
+// One epoch pass over a flattened corpus: frequency subsampling + shrunk-
+// window context generation, emitting fixed-width rows.
+//
+// Inputs:
+//   ids        — concatenated sentence word-indices, int32[total_len]
+//   offsets    — sentence boundaries, int64[n_sentences+1]
+//   keep_prob  — per-word keep probability, float32[vocab] (all-1 disables)
+//   window     — reference windowSize; per position draw b in [0, window)
+//                and take offsets [-b, b-1] \ {0} (mllib:384-388)
+//   seed       — epoch seed (caller mixes epoch index)
+// Outputs (caller-allocated, capacity rows >= total_len):
+//   centers    — int32[capacity]
+//   contexts   — int32[capacity * ctx_width]   (ctx_width = 2*window - 3,
+//                matching corpus.batching.context_width; zero-padded)
+//   mask       — float32[capacity * ctx_width]
+// Returns the number of rows written (= number of kept word positions), or
+// -1 if capacity was insufficient.
+int64_t window_batch_epoch(
+    const int32_t* ids, const int64_t* offsets, int64_t n_sentences,
+    const float* keep_prob, int32_t window, uint64_t seed,
+    int32_t* centers, int32_t* contexts, float* mask,
+    int64_t capacity, int64_t* words_done_out) {
+    const int64_t W = window;
+    const int64_t C = (2 * W - 3) > 1 ? (2 * W - 3) : 1;
+    Rng rng(seed);
+    int64_t row = 0;
+    int64_t words_done = 0;
+    std::vector<int32_t> kept;
+    for (int64_t s = 0; s < n_sentences; ++s) {
+        const int64_t beg = offsets[s], end = offsets[s + 1];
+        words_done += end - beg;
+        kept.clear();
+        for (int64_t i = beg; i < end; ++i) {
+            const int32_t w = ids[i];
+            const float kp = keep_prob[w];
+            if (kp >= 1.0f || rng.next_double() <= kp) kept.push_back(w);
+        }
+        const int64_t L = static_cast<int64_t>(kept.size());
+        if (row + L > capacity) return -1;
+        for (int64_t i = 0; i < L; ++i) {
+            const int64_t b = (W > 0) ? rng.next_below(W) : 0;  // [0, W)
+            centers[row] = kept[i];
+            int32_t* ctx = contexts + row * C;
+            float* m = mask + row * C;
+            std::memset(ctx, 0, sizeof(int32_t) * C);
+            std::memset(m, 0, sizeof(float) * C);
+            // context positions [max(0,i-b), min(i+b,L)) excluding i;
+            // lane layout matches corpus.batching.window_offsets:
+            // lanes [0, W-1) hold offsets -(W-1)..-1, lanes [W-1, C) hold
+            // offsets 1..W-2.
+            const int64_t lo = (i - b) > 0 ? (i - b) : 0;
+            const int64_t hi = (i + b) < L ? (i + b) : L;
+            for (int64_t j = lo; j < hi; ++j) {
+                if (j == i) continue;
+                const int64_t off = j - i;  // in [-(W-1), W-2], != 0
+                const int64_t lane = off < 0 ? off + (W - 1) : (W - 1) + off - 1;
+                ctx[lane] = kept[static_cast<size_t>(j)];
+                m[lane] = 1.0f;
+            }
+            ++row;
+        }
+    }
+    if (words_done_out) *words_done_out = words_done;
+    return row;
+}
+
+}  // extern "C"

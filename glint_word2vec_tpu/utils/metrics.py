@@ -1,0 +1,115 @@
+"""Training observability: throughput counters, step timing, profiler hook.
+
+The reference's only training telemetry is a log line every 10k words with
+the annealed alpha and the last positive dot product as a divergence canary
+(mllib:399-413; SURVEY.md §5 "tracing: none"). This module is the richer
+TPU-native replacement: words/sec and steps/sec over a sliding window,
+wall-clock split between host batching and device step dispatch, a running
+loss, and an optional ``jax.profiler`` trace capture around a step range.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrainingMetrics:
+    """Accumulates per-run training statistics; cheap enough for every step."""
+
+    log_every: int = 200
+    #: Global words_done at construction (nonzero after a checkpoint
+    #: resume); rates count only words processed by this invocation.
+    base_words: int = 0
+    steps: int = 0
+    words_done: int = 0
+    host_time: float = 0.0  # seconds spent producing batches
+    step_time: float = 0.0  # seconds spent in train-step dispatch
+    last_loss: Optional[float] = None
+    _t_start: float = field(default_factory=time.time)
+    _t_window: float = field(default_factory=time.time)
+    _words_window: int = -1  # sentinel: initialized on first record_step
+    history: List[dict] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.words_done = self.base_words
+        self._words_window = self.base_words
+
+    def record_step(self, words_done: int, loss=None, alpha=None) -> None:
+        self.steps += 1
+        self.words_done = words_done
+        if self.steps % self.log_every == 0:
+            now = time.time()
+            wps = (words_done - self._words_window) / max(now - self._t_window, 1e-9)
+            if loss is not None:
+                self.last_loss = float(loss)  # device sync point, on purpose
+            entry = {
+                "step": self.steps,
+                "words_done": words_done,
+                "words_per_sec": round(wps, 1),
+                "alpha": alpha,
+                "loss": self.last_loss,
+                "host_frac": round(
+                    self.host_time / max(self.host_time + self.step_time, 1e-9), 3
+                ),
+            }
+            self.history.append(entry)
+            logger.info(
+                "step %d: %.0f words/s alpha=%s loss=%s host_frac=%s",
+                self.steps, wps, alpha, self.last_loss, entry["host_frac"],
+            )
+            self._t_window, self._words_window = now, words_done
+
+    @contextlib.contextmanager
+    def timing(self, kind: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            dt = time.time() - t0
+            if kind == "host":
+                self.host_time += dt
+            else:
+                self.step_time += dt
+
+    def summary(self) -> dict:
+        wall = max(time.time() - self._t_start, 1e-9)
+        return {
+            "steps": self.steps,
+            "words_done": self.words_done,
+            "wall_seconds": round(wall, 2),
+            "words_per_sec": round((self.words_done - self.base_words) / wall, 1),
+            "host_time": round(self.host_time, 2),
+            "step_time": round(self.step_time, 2),
+            "final_loss": self.last_loss,
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"summary": self.summary(), "history": self.history}, f)
+
+
+@contextlib.contextmanager
+def profile_trace(out_dir: Optional[str]):
+    """Capture a ``jax.profiler`` trace into ``out_dir`` (None = no-op).
+
+    View with TensorBoard's profile plugin or xprof; the TPU-native answer
+    to the reference having no profiling at all (SURVEY.md §5).
+    """
+    if not out_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,147 @@
+"""Tests for the native C++ host-ops library (native/host_ops.cpp) and its
+equivalence to the Python reference implementations."""
+
+import time
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus.alias import AliasTable, unigram_weights
+from glint_word2vec_tpu.corpus.batching import window_offsets
+from glint_word2vec_tpu.native import (
+    alias_build_native,
+    get_lib,
+    window_batch_epoch_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="native host_ops unavailable"
+)
+
+
+def _alias_distribution(prob, alias):
+    n = prob.shape[0]
+    recon = prob.astype(np.float64).copy()
+    for j in range(n):
+        if prob[j] < 1.0:
+            recon[alias[j]] += 1.0 - float(prob[j])
+    return recon / n
+
+
+def test_native_alias_matches_target_distribution():
+    counts = np.array([1000, 100, 10, 7, 3, 1], np.int64)
+    w = unigram_weights(counts)
+    prob, alias = alias_build_native(w)
+    np.testing.assert_allclose(
+        _alias_distribution(prob, alias), w / w.sum(), atol=1e-7
+    )
+
+
+def test_native_alias_validates_inputs():
+    with pytest.raises(ValueError):
+        alias_build_native(np.array([0.0, 0.0]))
+    with pytest.raises(ValueError):
+        alias_build_native(np.array([-1.0, 1.0]))
+
+
+def test_native_alias_sampling_statistics():
+    counts = np.array([1000, 100, 10, 1], np.int64)
+    w = unigram_weights(counts)
+    prob, alias = alias_build_native(w)
+    t = AliasTable(prob=prob, alias=alias)
+    draws = t.sample(np.random.default_rng(0), 200_000)
+    freq = np.bincount(draws, minlength=4) / draws.size
+    np.testing.assert_allclose(freq, w / w.sum(), atol=0.01)
+
+
+def _epoch(ids_list, window, keep_prob=None, seed=7):
+    ids = np.concatenate(ids_list).astype(np.int32)
+    lens = np.array([len(s) for s in ids_list], np.int64)
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    if keep_prob is None:
+        keep_prob = np.ones(int(ids.max()) + 1, np.float32)
+    return window_batch_epoch_native(ids, offsets, keep_prob, window, seed)
+
+
+def test_native_window_structural_invariants():
+    W = 4
+    offsets = window_offsets(W)
+    sent = np.arange(1, 40, dtype=np.int32)  # distinct ids = positions+1
+    centers, contexts, mask, words_done = _epoch([sent], W)
+    assert words_done == 39
+    assert centers.shape[0] == 39  # keep_prob 1 keeps everything
+    np.testing.assert_array_equal(centers, sent)
+    for i in range(39):
+        valid = mask[i] > 0
+        # Lane layout must match corpus.batching.window_offsets; every valid
+        # lane holds the word at position i+offset.
+        for lane in np.nonzero(valid)[0]:
+            j = i + offsets[lane]
+            assert 0 <= j < 39
+            assert contexts[i, lane] == sent[j]
+        # Valid offsets must be exactly [-b, b-1] (clipped): contiguous.
+        offs = sorted(offsets[valid])
+        if offs:
+            # Infer the drawn b: reach is [-b, b-1] before boundary clipping.
+            b = max(-offs[0], offs[-1] + 1)
+            expected = [o for o in range(-b, b) if o != 0
+                        and 0 <= i + o < 39]
+            assert offs == expected
+        # Masked lanes zero-padded.
+        assert np.all(contexts[i][~valid] == 0)
+
+
+def test_native_window_b_distribution():
+    # b ~ U[0, W): mean context size for interior positions ~ 2*mean(b)-...
+    # Just check b=0 occurs (empty rows) and max reach is W-1 / W-2.
+    W = 5
+    offsets = window_offsets(W)
+    sent = np.arange(1, 2001, dtype=np.int32)
+    centers, contexts, mask, _ = _epoch([sent], W, seed=3)
+    sizes = (mask > 0).sum(axis=1)
+    assert (sizes == 0).any()  # b=0 rows exist
+    used = offsets[np.nonzero((mask > 0).any(axis=0))[0]]
+    assert used.min() == -(W - 1) and used.max() == W - 2
+
+
+def test_native_subsampling_statistics():
+    keep = np.array([0.3, 1.0], np.float32)
+    sent = np.zeros(20000, np.int32)
+    centers, _, _, words_done = _epoch([sent], 3, keep_prob=keep, seed=9)
+    assert words_done == 20000  # pre-subsampling count
+    assert abs(centers.shape[0] / 20000 - 0.3) < 0.02
+
+
+def test_native_epoch_determinism():
+    sent = np.arange(1, 500, dtype=np.int32)
+    a = _epoch([sent], 5, seed=42)
+    b = _epoch([sent], 5, seed=42)
+    c = _epoch([sent], 5, seed=43)
+    np.testing.assert_array_equal(a[1], b[1])
+    np.testing.assert_array_equal(a[2], b[2])
+    assert not np.array_equal(a[2], c[2])
+
+
+def test_native_throughput_sanity():
+    # The reason this exists: the Python pass runs ~0.1M words/s. Require
+    # >2M words/s so a silent fallback or a pathological regression fails.
+    rng = np.random.default_rng(0)
+    sents = [rng.integers(0, 50_000, rng.integers(5, 40)).astype(np.int32)
+             for _ in range(20_000)]
+    total = sum(len(s) for s in sents)
+    t0 = time.time()
+    centers, contexts, mask, words_done = _epoch(sents, 5, keep_prob=np.ones(50_000, np.float32))
+    dt = time.time() - t0
+    assert words_done == total
+    wps = total / dt
+    assert wps > 2e6, f"native epoch pass too slow: {wps/1e6:.2f}M words/s"
+
+
+def test_native_alias_large_vocab_fast():
+    w = unigram_weights(np.random.default_rng(0).integers(1, 10**6, 1_000_000))
+    t0 = time.time()
+    prob, alias = alias_build_native(w)
+    dt = time.time() - t0
+    assert dt < 2.0, f"native alias build too slow: {dt:.1f}s at 1M vocab"
+    assert prob.shape == (1_000_000,)
