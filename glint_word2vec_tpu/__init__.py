@@ -33,6 +33,9 @@ __all__ = [
     "Word2VecModel",
     "LocalWord2VecModel",
     "Word2VecParams",
+    "FastTextWord2Vec",
+    "FastTextModel",
+    "FastTextParams",
 ]
 
 
@@ -42,6 +45,10 @@ def __getattr__(name):
         from glint_word2vec_tpu.models import word2vec
 
         return getattr(word2vec, name)
+    if name in ("FastTextWord2Vec", "FastTextModel", "FastTextParams"):
+        from glint_word2vec_tpu.models import fasttext
+
+        return getattr(fasttext, name)
     if name == "Word2VecParams":
         from glint_word2vec_tpu.utils.params import Word2VecParams
 

@@ -1,0 +1,97 @@
+"""Character n-gram subwords (fastText-style) for the subword model family.
+
+The reference framework is word-level only; subword buckets are the stretch
+capability named in this repo's target configs (BASELINE.json: "fastText
+char-ngram subword buckets — stretch sharded-matrix API beyond word-level").
+Conventions follow fastText: words are wrapped in '<'/'>' boundary markers,
+n-grams of length [min_n, max_n] are hashed with FNV-1a(32) into ``bucket``
+slots, and a word's input representation is the mean of its own vector and
+its n-gram bucket vectors. OOV words compose from buckets alone.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+FNV_OFFSET = 2166136261
+FNV_PRIME = 16777619
+MASK32 = 0xFFFFFFFF
+
+
+def fnv1a_32(data: bytes) -> int:
+    """FNV-1a 32-bit hash (the fastText n-gram hash)."""
+    h = FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * FNV_PRIME) & MASK32
+    return h
+
+
+def word_ngrams(word: str, min_n: int = 3, max_n: int = 6) -> List[str]:
+    """Character n-grams of '<word>' with lengths in [min_n, max_n].
+
+    The full wrapped token is excluded (it is represented by the word's own
+    vector); a wrapped token shorter than min_n yields no n-grams.
+    """
+    if min_n <= 0 or max_n < min_n:
+        raise ValueError("need 0 < min_n <= max_n")
+    wrapped = f"<{word}>"
+    L = len(wrapped)
+    out = []
+    # n is capped at L-1: the whole wrapped token (n == L) is excluded —
+    # it is represented by the word's own vector.
+    for n in range(min_n, min(max_n, L - 1) + 1):
+        for i in range(L - n + 1):
+            out.append(wrapped[i : i + n])
+    return out
+
+
+def ngram_bucket_ids(
+    word: str, vocab_size: int, bucket: int, min_n: int, max_n: int
+) -> List[int]:
+    """Bucket-row ids (offset by vocab_size) for a word's n-grams."""
+    return [
+        vocab_size + (fnv1a_32(g.encode("utf-8")) % bucket)
+        for g in word_ngrams(word, min_n, max_n)
+    ]
+
+
+def subword_group(
+    word: str,
+    word_id: int | None,
+    vocab_size: int,
+    bucket: int,
+    min_n: int,
+    max_n: int,
+    max_subwords: int,
+) -> List[int]:
+    """The id group whose mean represents ``word``: the word's own row (if
+    in-vocab) followed by its n-gram bucket rows, truncated to
+    ``max_subwords`` (the word's own row is never truncated away)."""
+    ids = [] if word_id is None else [word_id]
+    ids += ngram_bucket_ids(word, vocab_size, bucket, min_n, max_n)
+    return ids[:max_subwords]
+
+
+def build_subword_table(
+    words: Sequence[str],
+    vocab_size: int,
+    bucket: int,
+    min_n: int = 3,
+    max_n: int = 6,
+    max_subwords: int = 32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Precompute the (V, S) id/mask arrays mapping each vocab word to its
+    subword group; used host-side to expand minibatch centers."""
+    V = len(words)
+    ids = np.zeros((V, max_subwords), np.int32)
+    mask = np.zeros((V, max_subwords), np.float32)
+    for w_id, w in enumerate(words):
+        group = subword_group(
+            w, w_id, vocab_size, bucket, min_n, max_n, max_subwords
+        )
+        ids[w_id, : len(group)] = group
+        mask[w_id, : len(group)] = 1.0
+    return ids, mask

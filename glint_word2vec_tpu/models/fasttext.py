@@ -1,0 +1,285 @@
+"""fastText-style subword model family on the same sharded-matrix engine.
+
+Extends the word-level SGNS framework with character-n-gram bucket rows
+(BASELINE.json stretch config): the engine's table grows by ``bucket``
+extra rows (corpus/subword.py), a center word trains as the mean of its
+subword group's rows (``EmbeddingEngine.train_step_grouped``), and word
+vectors — including OOV words, which the word-level reference cannot
+represent at all — compose on device via ``pull_average``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from glint_word2vec_tpu.corpus.subword import build_subword_table, subword_group
+from glint_word2vec_tpu.corpus.vocab import Vocabulary
+from glint_word2vec_tpu.models.word2vec import (
+    MAX_QUERY_ROWS,
+    LocalWord2VecModel,
+    Word2Vec,
+    Word2VecModel,
+)
+from glint_word2vec_tpu.utils.params import Word2VecParams, _require
+
+
+@dataclass
+class FastTextParams(Word2VecParams):
+    """Word2Vec params + subword geometry (fastText conventions)."""
+
+    min_n: int = 3
+    max_n: int = 6
+    bucket: int = 2_000_000
+    max_subwords: int = 32
+
+    def validate(self) -> None:
+        super().validate()
+        _require(0 < self.min_n <= self.max_n, "need 0 < min_n <= max_n")
+        _require(self.bucket > 0, "bucket must be > 0")
+        _require(self.max_subwords >= 2, "max_subwords must be >= 2")
+
+
+class FastTextWord2Vec(Word2Vec):
+    """Subword SGNS estimator. Same fluent surface as Word2Vec, plus
+    subword knobs; fit() shares the full word-level training loop
+    (LR anneal, metrics, checkpoint/resume) via the family hooks."""
+
+    def __init__(self, params: Optional[FastTextParams] = None, mesh=None, **kw):
+        super().__init__(params or FastTextParams(), mesh=mesh, **kw)
+        if not isinstance(self.params, FastTextParams):
+            raise TypeError("FastTextWord2Vec requires FastTextParams")
+        self._sub_ids: Optional[np.ndarray] = None
+        self._sub_mask: Optional[np.ndarray] = None
+
+    def set_min_n(self, v: int) -> "FastTextWord2Vec":
+        return self._set(min_n=v)
+
+    def set_max_n(self, v: int) -> "FastTextWord2Vec":
+        return self._set(max_n=v)
+
+    def set_bucket(self, v: int) -> "FastTextWord2Vec":
+        return self._set(bucket=v)
+
+    def set_max_subwords(self, v: int) -> "FastTextWord2Vec":
+        return self._set(max_subwords=v)
+
+    # Family hooks -----------------------------------------------------
+
+    def _make_engine(self, mesh, vocab: Vocabulary):
+        from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+
+        p = self.params
+        self._sub_ids, self._sub_mask = build_subword_table(
+            vocab.words, vocab.size, p.bucket, p.min_n, p.max_n, p.max_subwords
+        )
+        return EmbeddingEngine(
+            mesh,
+            vocab.size,
+            p.vector_size,
+            vocab.counts,
+            num_negatives=p.num_negatives,
+            unigram_power=p.unigram_power,
+            unigram_table_size=p.unigram_table_size,
+            seed=p.seed,
+            dtype=p.dtype,
+            extra_rows=p.bucket,
+        )
+
+    def _train_batch(self, engine, batch, key, alpha):
+        # Host-side expansion of center words to their subword groups;
+        # padded batch rows (center 0) carry zero context masks, so their
+        # group updates are zeroed by the gradient coefficients.
+        groups = self._sub_ids[batch.centers]
+        gmask = self._sub_mask[batch.centers]
+        return engine.train_step_grouped(
+            groups, gmask, batch.contexts, batch.mask, key, alpha
+        )
+
+    def _make_model(self, vocab: Vocabulary, engine) -> "FastTextModel":
+        return FastTextModel(
+            vocab, engine, self.params, self._sub_ids, self._sub_mask
+        )
+
+
+class FastTextModel(Word2VecModel):
+    """Fitted subword model: all word vectors (in-vocab AND out-of-vocab)
+    compose on device as the mean of subword rows."""
+
+    def __init__(self, vocab, engine, params: FastTextParams, sub_ids, sub_mask):
+        super().__init__(vocab, engine, params)
+        self._sub_ids = sub_ids
+        self._sub_mask = sub_mask
+
+    # -- composition ---------------------------------------------------
+
+    #: Fixed row-block size for composition calls: bounds XLA to at most
+    #: two compiled shapes (full block + final remainder) regardless of
+    #: input sizes.
+    COMPOSE_BLOCK = 4096
+
+    def _compose_device(self, groups: np.ndarray, gmask: np.ndarray):
+        """Compose one block on device; returns a device array."""
+        return self.engine.pull_average(groups, gmask)
+
+    def _compose(self, groups: np.ndarray, gmask: np.ndarray) -> np.ndarray:
+        """Compose arbitrarily many rows, block-quantized to COMPOSE_BLOCK
+        (padded with row 0 / zero mask, sliced off after) so repeated calls
+        never trigger per-shape recompiles."""
+        n = groups.shape[0]
+        B = self.COMPOSE_BLOCK
+        out = np.empty((n, self.vector_size), np.float32)
+        for s in range(0, n, B):
+            e = min(s + B, n)
+            g, m = groups[s:e], gmask[s:e]
+            if e - s < B:
+                pad = B - (e - s)
+                g = np.pad(g, ((0, pad), (0, 0)))
+                m = np.pad(m, ((0, pad), (0, 0)))
+            out[s:e] = np.asarray(self._compose_device(g, m))[: e - s]
+        return out
+
+    def _oov_group(self, word: str) -> Tuple[np.ndarray, np.ndarray]:
+        p: FastTextParams = self.params
+        ids = subword_group(
+            word, None, self.vocab.size, p.bucket, p.min_n, p.max_n,
+            p.max_subwords,
+        )
+        if not ids:
+            raise KeyError(
+                f"word {word!r} is OOV and too short for any "
+                f"[{p.min_n},{p.max_n}]-gram"
+            )
+        g = np.zeros((1, p.max_subwords), np.int32)
+        m = np.zeros((1, p.max_subwords), np.float32)
+        g[0, : len(ids)] = ids
+        m[0, : len(ids)] = 1.0
+        return g, m
+
+    def transform(self, word: str) -> np.ndarray:
+        """Word -> composed vector. Unlike the word-level model, OOV words
+        are representable (fastText's defining capability)."""
+        idx = self.vocab.word_index.get(word)
+        if idx is not None:
+            g, m = self._sub_ids[idx : idx + 1], self._sub_mask[idx : idx + 1]
+        else:
+            g, m = self._oov_group(word)
+        return self._compose(g, m)[0]
+
+    def transform_words(self, words: Sequence[str]) -> np.ndarray:
+        out = np.empty((len(words), self.vector_size), np.float32)
+        for s in range(0, len(words), MAX_QUERY_ROWS):
+            chunk = words[s : s + MAX_QUERY_ROWS]
+            idx = self.vocab.encode_strict(chunk)  # strict, like word-level
+            out[s : s + len(chunk)] = self._compose(
+                self._sub_ids[idx], self._sub_mask[idx]
+            )
+        return out
+
+    def transform_sentences(self, sentences) -> np.ndarray:
+        """Mean of composed word vectors per sentence (OOV words dropped,
+        matching the word-level DataFrame-transform semantics).
+
+        All chunk words are composed in fixed-size device blocks (one or
+        two compiled shapes total), then segment-averaged on host — no
+        per-sentence device calls."""
+        sentences = list(sentences)
+        out = np.zeros((len(sentences), self.vector_size), np.float32)
+        encoded = [self.vocab.encode(s) for s in sentences]
+        flat = (
+            np.concatenate([e for e in encoded if e.size])
+            if any(e.size for e in encoded)
+            else np.zeros(0, np.int32)
+        )
+        if flat.size == 0:
+            return out
+        vecs = self._compose(self._sub_ids[flat], self._sub_mask[flat])
+        pos = 0
+        for i, e in enumerate(encoded):
+            if e.size:
+                out[i] = vecs[pos : pos + e.size].mean(axis=0)
+                pos += e.size
+        return out
+
+    # -- similarity over composed vectors ------------------------------
+
+    def _query_engine(self):
+        """A second sharded engine whose syn0 holds the composed per-word
+        vectors, assembled entirely on device (compose block ->
+        ``write_rows``; nothing of O(vocab x dim) ever touches the host).
+        Built lazily, cached; similarity queries then reuse the standard
+        distributed top-k."""
+        if getattr(self, "_qeng", None) is None:
+            from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+
+            qeng = EmbeddingEngine(
+                self.engine.mesh,
+                self.vocab.size,
+                self.vector_size,
+                self.vocab.counts,
+                num_negatives=self.engine.num_negatives,
+                seed=0,
+            )
+            B = self.COMPOSE_BLOCK
+            for s in range(0, self.vocab.size, B):
+                e = min(s + B, self.vocab.size)
+                block = self._compose_device(
+                    self._sub_ids[s:e], self._sub_mask[s:e]
+                )
+                qeng.write_rows(s, block)
+            self._qeng = qeng
+        return self._qeng
+
+    def find_synonyms_vector(self, vector, num: int) -> List[Tuple[str, float]]:
+        if num <= 0:
+            raise ValueError("num must be > 0")
+        num = min(num, self.vocab.size)
+        sims, idx = self._query_engine().top_k_cosine(
+            np.asarray(vector, np.float32), num
+        )
+        return [
+            (self.vocab.words[int(i)], float(s))
+            for s, i in zip(sims, idx)
+            if int(i) < self.vocab.size
+        ]
+
+    def to_local(self) -> LocalWord2VecModel:
+        qeng = self._query_engine()
+        vecs = np.empty((self.vocab.size, self.vector_size), np.float32)
+        for s in range(0, self.vocab.size, MAX_QUERY_ROWS):
+            idx = np.arange(s, min(s + MAX_QUERY_ROWS, self.vocab.size), dtype=np.int32)
+            vecs[s : s + len(idx)] = np.asarray(qeng.pull(idx))
+        return LocalWord2VecModel(list(self.vocab.words), vecs)
+
+    def get_vectors(self):
+        qeng = self._query_engine()
+        for s in range(0, self.vocab.size, MAX_QUERY_ROWS):
+            idx = np.arange(s, min(s + MAX_QUERY_ROWS, self.vocab.size), dtype=np.int32)
+            rows = np.asarray(qeng.pull(idx))
+            for i, r in zip(idx, rows):
+                yield self.vocab.words[int(i)], r
+
+    def stop(self) -> None:
+        if getattr(self, "_qeng", None) is not None:
+            self._qeng.destroy()
+            self._qeng = None
+        super().stop()
+
+    # -- persistence ---------------------------------------------------
+    # save() is inherited: engine.save persists bucket rows via extra_rows
+    # and params.json carries the subword geometry. load() shares the base
+    # path via the hooks below; the subword table is rebuilt
+    # deterministically from the words + geometry.
+
+    _PARAMS_CLS = FastTextParams
+
+    @classmethod
+    def _from_loaded(cls, vocab, engine, params) -> "FastTextModel":
+        sub_ids, sub_mask = build_subword_table(
+            vocab.words, vocab.size, params.bucket, params.min_n,
+            params.max_n, params.max_subwords,
+        )
+        return cls(vocab, engine, params, sub_ids, sub_mask)

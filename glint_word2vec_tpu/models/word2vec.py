@@ -36,6 +36,7 @@ from glint_word2vec_tpu.corpus.batching import (
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
 from glint_word2vec_tpu.utils.metrics import TrainingMetrics
 from glint_word2vec_tpu.utils.params import Word2VecParams
+from glint_word2vec_tpu.utils.prefetch import prefetch
 
 logger = logging.getLogger(__name__)
 
@@ -166,17 +167,7 @@ class Word2Vec:
                 f"batch_size ({p.batch_size}) must be divisible by the "
                 f"data-axis size ({mesh.shape['data']})"
             )
-        engine = EmbeddingEngine(
-            mesh,
-            vocab.size,
-            p.vector_size,
-            vocab.counts,
-            num_negatives=p.num_negatives,
-            unigram_power=p.unigram_power,
-            unigram_table_size=p.unigram_table_size,
-            seed=p.seed,
-            dtype=p.dtype,
-        )
+        engine = self._make_engine(mesh, vocab)
         batcher = SkipGramBatcher(
             encoded,
             vocab,
@@ -222,7 +213,7 @@ class Word2Vec:
             os.makedirs(ck, exist_ok=True)
             for name, table in (("syn0", engine.syn0), ("syn1", engine.syn1)):
                 tmp = os.path.join(ck, f".{name}.tmp.npy")
-                np.save(tmp, np.asarray(table, np.float32)[: vocab.size])
+                np.save(tmp, np.asarray(table, np.float32)[: engine.num_rows])
                 os.replace(tmp, os.path.join(ck, f"{name}.npy"))
             tmp = state_path + ".tmp"
             with open(tmp, "w") as f:
@@ -237,7 +228,9 @@ class Word2Vec:
             os.replace(tmp, state_path)
 
         for epoch in range(start_epoch, p.num_iterations):
-            it = batcher.epoch(epoch)
+            # Double-buffered infeed: batches are produced on a background
+            # thread while the device executes (utils/prefetch.py).
+            it = prefetch(batcher.epoch(epoch), depth=2)
             while True:
                 with metrics.timing("host"):
                     batch = next(it, None)
@@ -249,9 +242,7 @@ class Word2Vec:
                 )
                 key = jax.random.fold_in(base_key, step)
                 with metrics.timing("step"):
-                    loss = engine.train_step(
-                        batch.centers, batch.contexts, batch.mask, key, alpha
-                    )
+                    loss = self._train_batch(engine, batch, key, alpha)
                 step += 1
                 metrics.record_step(batch.words_done, loss=loss, alpha=alpha)
             stopping = (
@@ -266,9 +257,35 @@ class Word2Vec:
                 logger.info("stopping early after epoch %d", epoch + 1)
                 break
         logger.info("training done: %s", metrics.summary())
-        model = Word2VecModel(vocab, engine, p)
+        model = self._make_model(vocab, engine)
         model.training_metrics = metrics.summary()
         return model
+
+    # Hooks specialized by subword/other model families (models/fasttext.py).
+
+    def _make_engine(self, mesh, vocab: Vocabulary):
+        from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+
+        p = self.params
+        return EmbeddingEngine(
+            mesh,
+            vocab.size,
+            p.vector_size,
+            vocab.counts,
+            num_negatives=p.num_negatives,
+            unigram_power=p.unigram_power,
+            unigram_table_size=p.unigram_table_size,
+            seed=p.seed,
+            dtype=p.dtype,
+        )
+
+    def _train_batch(self, engine, batch, key, alpha):
+        return engine.train_step(
+            batch.centers, batch.contexts, batch.mask, key, alpha
+        )
+
+    def _make_model(self, vocab: Vocabulary, engine) -> "Word2VecModel":
+        return Word2VecModel(vocab, engine, self.params)
 
 
 class Word2VecModel:
@@ -421,16 +438,20 @@ class Word2VecModel:
         with open(os.path.join(path, "params.json"), "w") as f:
             f.write(self.params.to_json())
 
+    #: Params class used by :meth:`load`; model families override.
+    _PARAMS_CLS = Word2VecParams
+
     @classmethod
     def load(cls, path: str, mesh=None) -> "Word2VecModel":
         """Rebuild from :meth:`save` output onto any mesh — the analogue of
         loading onto a fresh or *different* PS cluster (mllib:696-725;
-        host-override at ml:584-586)."""
+        host-override at ml:584-586). Shared by all model families; the
+        family-specific tail lives in :meth:`_from_loaded`."""
         from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
         from glint_word2vec_tpu.parallel.mesh import make_mesh
 
         with open(os.path.join(path, "params.json")) as f:
-            params = Word2VecParams.from_json(f.read())
+            params = cls._PARAMS_CLS.from_json(f.read())
         with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
             words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
         if mesh is None:
@@ -448,6 +469,10 @@ class Word2VecModel:
             word_index={w: i for i, w in enumerate(words)},
             train_words_count=int(counts.sum()),
         )
+        return cls._from_loaded(vocab, engine, params)
+
+    @classmethod
+    def _from_loaded(cls, vocab, engine, params) -> "Word2VecModel":
         return cls(vocab, engine, params)
 
     def stop(self) -> None:

@@ -116,13 +116,22 @@ class EmbeddingEngine:
         unigram_table_size: Optional[int] = None,
         seed: int = 1,
         dtype: str = "float32",
+        extra_rows: int = 0,
     ):
+        """``extra_rows`` appends non-vocabulary rows to both tables (e.g.
+        fastText char-ngram buckets, models/fasttext.py): they are trained
+        through subword center groups but are never negative-sampled (the
+        noise table spans the vocab only) and never surface from the query
+        ops (top-k masks them; norms/multiply callers slice)."""
         if vocab_size <= 0 or dim <= 0:
             raise ValueError("vocab_size and dim must be > 0")
         if counts.shape != (vocab_size,):
             raise ValueError("counts must have shape (vocab_size,)")
+        if extra_rows < 0:
+            raise ValueError("extra_rows must be >= 0")
         self.mesh = mesh
         self.vocab_size = int(vocab_size)
+        self.num_rows = int(vocab_size) + int(extra_rows)
         self.dim = int(dim)
         self.num_negatives = int(num_negatives)
         self.unigram_power = float(unigram_power)
@@ -130,7 +139,7 @@ class EmbeddingEngine:
         self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
         self.num_data = mesh.shape[DATA_AXIS]
         self.num_model = mesh.shape[MODEL_AXIS]
-        self.padded_vocab = pad_to_multiple(self.vocab_size, self.num_model)
+        self.padded_vocab = pad_to_multiple(self.num_rows, self.num_model)
         self.rows_per_shard = self.padded_vocab // self.num_model
 
         # Noise distribution over the *unpadded* vocab — draws are therefore
@@ -149,7 +158,7 @@ class EmbeddingEngine:
         # Randoms are drawn for the unpadded rows only, then zero-padded, so
         # initial values are also mesh-shape-invariant.
         tsh = table_sharding(mesh)
-        V, Vp, d = self.vocab_size, self.padded_vocab, self.dim
+        V, Vp, d = self.num_rows, self.padded_vocab, self.dim
 
         def _init(key):
             s0, s1 = sgns.init_tables(key, V, d, self._dtype)
@@ -184,10 +193,15 @@ class EmbeddingEngine:
         tspec = P(MODEL_AXIS, None)
         rep = P()
 
-        def local_train_step(syn0_l, syn1_l, prob, alias, centers, contexts,
-                             mask, key, alpha):
-            # centers/contexts/mask arrive data-sharded: (Bl,), (Bl, C).
-            Bl, C = contexts.shape
+        def local_train_step(syn0_l, syn1_l, prob, alias, centers, cmask,
+                             contexts, mask, key, alpha):
+            # Data-sharded inputs: centers/cmask (Bl, S), contexts/mask
+            # (Bl, C). S = subword-group width; word-level training is the
+            # S=1 specialization. The center representation is the masked
+            # mean of its group's syn0 rows (fastText composition; for S=1
+            # this is exactly the plain word vector).
+            Bl, S = centers.shape
+            C = contexts.shape[1]
             start = lax.axis_index(MODEL_AXIS) * Vs
             drank = lax.axis_index(DATA_AXIS)
             # Mesh-invariant negatives: draw for the full global batch from
@@ -196,7 +210,10 @@ class EmbeddingEngine:
             negs_full = sample_negatives(key, prob, alias, (B, C, n))
             negs = lax.dynamic_slice_in_dim(negs_full, drank * Bl, Bl, axis=0)
 
-            h = _pull_rows(syn0_l, centers, start, Vs)
+            h_rows = _pull_rows(syn0_l, centers.reshape(-1), start, Vs)
+            h_rows = h_rows.reshape(Bl, S, -1)
+            cnt = jnp.maximum(cmask.sum(axis=1, keepdims=True), 1.0)  # (Bl,1)
+            h = (h_rows * cmask[..., None]).sum(axis=1) / cnt
             u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs)
             u_pos = u_pos.reshape(Bl, C, -1)
             u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs)
@@ -206,17 +223,21 @@ class EmbeddingEngine:
                                 alpha.astype(jnp.float32))
 
             # Rank-1 update payloads (the reference's gPlus/gMinus scalars
-            # expanded client-side, mllib:422-425).
+            # expanded client-side, mllib:422-425). The center gradient is
+            # distributed over the group's rows (d mean / d row = 1/count).
             d_upos = g.c_pos[..., None] * h[:, None, :]
             d_uneg = g.c_neg[..., None] * h[:, None, None, :]
+            d_sub = (g.d_center / cnt)[:, None, :] * cmask[..., None]
             ids1 = jnp.concatenate([contexts.reshape(-1), negs.reshape(-1)])
             upd1 = jnp.concatenate(
                 [d_upos.reshape(Bl * C, -1), d_uneg.reshape(Bl * C * n, -1)]
             )
             # Exchange updates across the data axis, then each shard applies
             # the slice it owns.
-            ids0_g = lax.all_gather(centers, DATA_AXIS, tiled=True)
-            upd0_g = lax.all_gather(g.d_center, DATA_AXIS, tiled=True)
+            ids0_g = lax.all_gather(centers.reshape(-1), DATA_AXIS, tiled=True)
+            upd0_g = lax.all_gather(
+                d_sub.reshape(Bl * S, -1), DATA_AXIS, tiled=True
+            )
             ids1_g = lax.all_gather(ids1, DATA_AXIS, tiled=True)
             upd1_g = lax.all_gather(upd1, DATA_AXIS, tiled=True)
             syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs)
@@ -233,8 +254,9 @@ class EmbeddingEngine:
         self._train_step = jax.jit(
             self._shard_map(
                 local_train_step,
-                in_specs=(tspec, tspec, rep, rep, P(DATA_AXIS),
-                          P(DATA_AXIS, None), P(DATA_AXIS, None), rep, rep),
+                in_specs=(tspec, tspec, rep, rep, P(DATA_AXIS, None),
+                          P(DATA_AXIS, None), P(DATA_AXIS, None),
+                          P(DATA_AXIS, None), rep, rep),
                 out_specs=(tspec, tspec, rep),
             ),
             donate_argnums=(0, 1),
@@ -294,12 +316,16 @@ class EmbeddingEngine:
                 start = lax.axis_index(MODEL_AXIS) * Vs
                 kk = min(k, Vs)
                 scores = table_l.astype(jnp.float32) @ v
-                # Zero-norm rows (incl. vocab padding) must never outrank a
-                # real word with negative cosine: score them -inf (the
-                # reference's zero-norm guard at mllib:603-609 only had to
-                # avoid a 0/0).
+                # Zero-norm rows must never outrank a real word with
+                # negative cosine: score them -inf (the reference's
+                # zero-norm guard at mllib:603-609 only had to avoid a 0/0).
+                # Likewise rows past vocab_size (padding / subword buckets):
+                # only real words may surface from similarity search.
                 safe = jnp.where(norms_l > 0, norms_l, 1.0)
-                cos = jnp.where(norms_l > 0, scores / safe, -jnp.inf)
+                is_word = (start + jnp.arange(Vs)) < self.vocab_size
+                cos = jnp.where(
+                    (norms_l > 0) & is_word, scores / safe, -jnp.inf
+                )
                 val, idx = lax.top_k(cos, kk)
                 cand_val = lax.all_gather(val, MODEL_AXIS, tiled=True)
                 cand_idx = lax.all_gather(idx + start, MODEL_AXIS, tiled=True)
@@ -332,14 +358,29 @@ class EmbeddingEngine:
         ``adjust`` round trip (mllib:421-425). Batch rows must be divisible
         by the data-axis size.
         """
-        B = centers.shape[0]
+        centers = jnp.asarray(centers)
+        return self.train_step_grouped(
+            centers[:, None], jnp.ones_like(centers, dtype=jnp.float32)[:, None],
+            contexts, mask, key, alpha,
+        )
+
+    def train_step_grouped(
+        self, center_groups, group_mask, contexts, mask, key, alpha
+    ) -> float:
+        """SGNS update with grouped centers: each center is the masked mean
+        of its group's syn0 rows (fastText subword composition; the center
+        gradient splits 1/count over the group's rows). Word-level training
+        is the width-1 special case used by :meth:`train_step`."""
+        B = center_groups.shape[0]
         if B % self.num_data:
             raise ValueError(
                 f"batch size {B} not divisible by data axis {self.num_data}"
             )
         self.syn0, self.syn1, loss = self._train_step(
             self.syn0, self.syn1, self._prob, self._alias,
-            jnp.asarray(centers), jnp.asarray(contexts),
+            jnp.asarray(center_groups),
+            jnp.asarray(group_mask, dtype=jnp.float32),
+            jnp.asarray(contexts),
             jnp.asarray(mask, dtype=jnp.float32), key,
             jnp.float32(alpha),
         )
@@ -365,10 +406,32 @@ class EmbeddingEngine:
             jnp.asarray(mask, dtype=jnp.float32),
         )
 
+    def write_rows(self, start_row: int, rows: jax.Array) -> None:
+        """Overwrite ``rows.shape[0]`` consecutive syn0 rows starting at
+        ``start_row``, entirely on device (used to assemble derived tables,
+        e.g. composed subword vectors, without a host round-trip). The
+        start index is a traced argument, so chunked writers compile once
+        per chunk shape."""
+        if not hasattr(self, "_write_rows_fn"):
+            self._write_rows_fn = jax.jit(
+                lambda table, block, s: jax.lax.dynamic_update_slice(
+                    table, block.astype(table.dtype), (s, 0)
+                ),
+                out_shardings=table_sharding(self.mesh),
+                donate_argnums=(0,),
+            )
+        self.syn0 = self._write_rows_fn(
+            self.syn0, rows, jnp.int32(start_row)
+        )
+        self._norms_cache = None
+
     def norms(self) -> jax.Array:
         """Per-row Euclidean norms of syn0, computed shard-local (Glint
         ``norms``, mllib:486), cached until the next table mutation.
-        Returns the padded-vocab array; rows past vocab_size are zero."""
+        Returns the padded-row-count array. With ``extra_rows`` > 0 the
+        bucket rows [vocab_size, num_rows) have nonzero norms — only
+        rows past ``num_rows`` (padding) are guaranteed zero; query ops
+        exclude non-vocab rows by index, not by norm."""
         if self._norms_cache is None:
             self._norms_cache = self._norms(self.syn0)
         return self._norms_cache
@@ -408,8 +471,8 @@ class EmbeddingEngine:
         mllib:494 — servers flushing shards to HDFS becomes device_get ->
         npy). Unpadded rows only; a future-mesh load re-pads freely."""
         os.makedirs(path, exist_ok=True)
-        syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.vocab_size]
-        syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.vocab_size]
+        syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.num_rows]
+        syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.num_rows]
         np.save(os.path.join(path, "syn0.npy"), syn0)
         np.save(os.path.join(path, "syn1.npy"), syn1)
         counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
@@ -420,6 +483,7 @@ class EmbeddingEngine:
             "num_negatives": self.num_negatives,
             "unigram_power": self.unigram_power,
             "unigram_table_size": self.unigram_table_size,
+            "extra_rows": self.num_rows - self.vocab_size,
             "dtype": "bfloat16" if self._dtype == jnp.bfloat16 else "float32",
         }
         with open(os.path.join(path, "engine.json"), "w") as f:
@@ -450,6 +514,7 @@ class EmbeddingEngine:
                 "unigram_table_size", meta.get("unigram_table_size")
             ),
             dtype=overrides.get("dtype", meta["dtype"]),
+            extra_rows=meta.get("extra_rows", 0),
         )
         syn0 = np.load(os.path.join(path, "syn0.npy"))
         syn1 = np.load(os.path.join(path, "syn1.npy"))
@@ -457,12 +522,13 @@ class EmbeddingEngine:
         return eng
 
     def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:
-        """Install host table values (unpadded), re-padding and re-sharding."""
-        if syn0.shape != (self.vocab_size, self.dim):
+        """Install host table values (unpadded, all num_rows rows),
+        re-padding and re-sharding."""
+        if syn0.shape != (self.num_rows, self.dim):
             raise ValueError("syn0 shape mismatch")
-        if syn1.shape != (self.vocab_size, self.dim):
+        if syn1.shape != (self.num_rows, self.dim):
             raise ValueError("syn1 shape mismatch")
-        pad = self.padded_vocab - self.vocab_size
+        pad = self.padded_vocab - self.num_rows
         tsh = table_sharding(self.mesh)
         full0 = np.pad(syn0, ((0, pad), (0, 0))).astype(np.float32)
         full1 = np.pad(syn1, ((0, pad), (0, 0))).astype(np.float32)

@@ -1,0 +1,74 @@
+"""Host->device infeed pipelining: background batch prefetch.
+
+The reference keeps exactly one minibatch RPC chain in flight per worker
+(the sequential ``Await`` over dotprod->adjust futures, mllib:419-429;
+SURVEY.md §2.3 "async pipelining"). The TPU equivalent: JAX dispatch is
+already asynchronous (the Python loop runs ahead of the device), so the
+only serial gap left is *producing* the next batch on host. This module
+moves that production to a daemon thread with a small bounded queue,
+overlapping the native windowing pass with device execution — the
+double-buffered infeed of SURVEY.md §7 step 3.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+def prefetch(it: Iterator[T], depth: int = 2) -> Iterator[T]:
+    """Iterate ``it`` on a daemon thread, keeping up to ``depth`` items
+    ready. Exceptions in the producer are re-raised at the consumer."""
+    if depth <= 0:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that notices consumer abandonment, so an abandoned
+        # prefetch never leaves the thread blocked forever on a full queue
+        # (pinning the source iterator and its buffers).
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            err.append(e)
+        finally:
+            _put(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True, name="batch-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer done or abandoned (exception/GeneratorExit upstream):
+        # release the producer and drop buffered items.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

@@ -198,6 +198,20 @@ def test_save_load_preserves_noise_geometry(tmp_path):
     np.testing.assert_array_equal(np.asarray(eng._prob), np.asarray(eng2._prob))
 
 
+def test_write_rows_device_side():
+    eng = _mk_engine(2, 4)
+    block = jnp.ones((8, D), jnp.float32) * 3.0
+    eng.write_rows(5, block)
+    rows = np.asarray(eng.pull(np.arange(4, 14, dtype=np.int32)))
+    np.testing.assert_array_equal(rows[1:9], np.full((8, D), 3.0, np.float32))
+    assert not np.allclose(rows[0], 3.0)  # neighbors untouched
+    assert not np.allclose(rows[9], 3.0)
+    # Norms cache invalidated by the write.
+    assert float(np.asarray(eng.norms())[5]) == pytest.approx(
+        3.0 * np.sqrt(D), rel=1e-6
+    )
+
+
 def test_destroy_frees_tables():
     eng = _mk_engine(1, 8)
     eng.destroy()
