@@ -10,7 +10,8 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "words/sec/chip", "vs_baseline": N}
 
 Environment knobs (for smoke-testing on CPU):
-  BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_STEPS, BENCH_PLATFORM
+  BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_STEPS, BENCH_PLATFORM,
+  BENCH_SPC (minibatches per device dispatch — the scan length)
 """
 
 import json
@@ -37,8 +38,10 @@ def main() -> None:
     V = int(os.environ.get("BENCH_VOCAB", 1_000_000))
     d = int(os.environ.get("BENCH_DIM", 300))
     B = int(os.environ.get("BENCH_BATCH", 8192))
-    steps = int(os.environ.get("BENCH_STEPS", 30))
+    steps = int(os.environ.get("BENCH_STEPS", 64))
+    spc = int(os.environ.get("BENCH_SPC", 32))  # minibatches per dispatch
     C, n = 7, 5  # window=5 context lanes, 5 negatives (reference defaults)
+    steps = (steps // spc) * spc or spc
 
     # Zipf-ish counts: realistic index skew for gathers and the noise table.
     ranks = np.arange(1, V + 1, dtype=np.float64)
@@ -49,26 +52,26 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     # Zipf-distributed center/context draws (the hot rows dominate, as in
-    # real corpora after subsampling).
+    # real corpora after subsampling). One stacked group of spc minibatches,
+    # dispatched as a single on-device lax.scan (engine.train_steps) — the
+    # production hot path of fit().
     p = (counts / counts.sum()).astype(np.float64)
-    n_unique_batches = 8
-    batches = []
-    for _ in range(n_unique_batches):
-        centers = rng.choice(V, size=B, p=p).astype(np.int32)
-        contexts = rng.choice(V, size=(B, C), p=p).astype(np.int32)
-        mask = (rng.random((B, C)) < 0.85).astype(np.float32)
-        batches.append((centers, contexts, mask))
+    centers_k = rng.choice(V, size=(spc, B), p=p).astype(np.int32)
+    contexts_k = rng.choice(V, size=(spc, B, C), p=p).astype(np.int32)
+    mask_k = (rng.random((spc, B, C)) < 0.85).astype(np.float32)
+    alphas = np.full(spc, 0.025, np.float32)
 
     key = jax.random.PRNGKey(0)
     # Warm up / compile.
-    loss = eng.train_step(*batches[0], key, 0.025)
-    jax.block_until_ready(loss)
+    losses = eng.train_steps(centers_k, contexts_k, mask_k, key, alphas, 0)
+    jax.block_until_ready(losses)
 
     t0 = time.time()
     last = None
-    for i in range(steps):
-        c, x, m = batches[i % n_unique_batches]
-        last = eng.train_step(c, x, m, jax.random.fold_in(key, i), 0.025)
+    for g in range(steps // spc):
+        last = eng.train_steps(
+            centers_k, contexts_k, mask_k, key, alphas, g * spc
+        )
     jax.block_until_ready(last)
     dt = time.time() - t0
 

@@ -89,14 +89,19 @@ class FastTextWord2Vec(Word2Vec):
             extra_rows=p.bucket,
         )
 
-    def _train_batch(self, engine, batch, key, alpha):
+    def _train_batches(self, engine, batches, base_key, step0, alphas):
         # Host-side expansion of center words to their subword groups;
         # padded batch rows (center 0) carry zero context masks, so their
         # group updates are zeroed by the gradient coefficients.
-        groups = self._sub_ids[batch.centers]
-        gmask = self._sub_mask[batch.centers]
-        return engine.train_step_grouped(
-            groups, gmask, batch.contexts, batch.mask, key, alpha
+        centers_k = np.stack([b.centers for b in batches])
+        return engine.train_steps_grouped(
+            self._sub_ids[centers_k],
+            self._sub_mask[centers_k],
+            np.stack([b.contexts for b in batches]),
+            np.stack([b.mask for b in batches]),
+            base_key,
+            alphas,
+            step0,
         )
 
     def _make_model(self, vocab: Vocabulary, engine) -> "FastTextModel":

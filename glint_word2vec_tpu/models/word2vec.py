@@ -28,6 +28,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from glint_word2vec_tpu.corpus.batching import (
+    Batch,
     SkipGramBatcher,
     chunk_sentences,
     context_width,
@@ -227,24 +228,53 @@ class Word2Vec:
                 )
             os.replace(tmp, state_path)
 
+        spc = p.steps_per_call
         for epoch in range(start_epoch, p.num_iterations):
             # Double-buffered infeed: batches are produced on a background
-            # thread while the device executes (utils/prefetch.py).
-            it = prefetch(batcher.epoch(epoch), depth=2)
+            # thread while the device executes (utils/prefetch.py), then
+            # dispatched ``steps_per_call`` at a time as one on-device scan
+            # (EmbeddingEngine.train_steps) — one host round-trip per group.
+            it = prefetch(batcher.epoch(epoch), depth=2 * spc)
             while True:
+                group = []
                 with metrics.timing("host"):
-                    batch = next(it, None)
-                if batch is None:
+                    while len(group) < spc:
+                        batch = next(it, None)
+                        if batch is None:
+                            break
+                        group.append(batch)
+                if not group:
                     break
-                alpha = max(
-                    p.step_size * (1 - batch.words_done / total_words),
-                    p.step_size * 1e-4,
-                )
-                key = jax.random.fold_in(base_key, step)
+                n_real = len(group)
+                if n_real < spc:
+                    # Pad the epoch-tail group to the full scan length so
+                    # the jitted scan never sees a second K (XLA compiles
+                    # are expensive). Zero-mask batches update nothing.
+                    proto = group[0]
+                    pad = Batch(
+                        centers=np.zeros_like(proto.centers),
+                        contexts=np.zeros_like(proto.contexts),
+                        mask=np.zeros_like(proto.mask),
+                        words_done=group[-1].words_done,
+                    )
+                    group.extend([pad] * (spc - n_real))
+                alphas = [
+                    max(
+                        p.step_size * (1 - b.words_done / total_words),
+                        p.step_size * 1e-4,
+                    )
+                    for b in group
+                ]
                 with metrics.timing("step"):
-                    loss = self._train_batch(engine, batch, key, alpha)
-                step += 1
-                metrics.record_step(batch.words_done, loss=loss, alpha=alpha)
+                    losses = self._train_batches(
+                        engine, group, base_key, step, np.asarray(alphas, np.float32)
+                    )
+                for i in range(n_real):
+                    step += 1
+                    metrics.record_step(
+                        group[i].words_done, loss=losses[i], alpha=alphas[i]
+                    )
+                step += len(group) - n_real  # padded steps consumed keys too
             stopping = (
                 stop_after_epochs is not None
                 and (epoch + 1 - start_epoch) >= stop_after_epochs
@@ -279,9 +309,16 @@ class Word2Vec:
             dtype=p.dtype,
         )
 
-    def _train_batch(self, engine, batch, key, alpha):
-        return engine.train_step(
-            batch.centers, batch.contexts, batch.mask, key, alpha
+    def _train_batches(self, engine, batches, base_key, step0, alphas):
+        """Dispatch a group of batches as one on-device scan; returns the
+        per-batch losses (lazy device array)."""
+        return engine.train_steps(
+            np.stack([b.centers for b in batches]),
+            np.stack([b.contexts for b in batches]),
+            np.stack([b.mask for b in batches]),
+            base_key,
+            alphas,
+            step0,
         )
 
     def _make_model(self, vocab: Vocabulary, engine) -> "Word2VecModel":

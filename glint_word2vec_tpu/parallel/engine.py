@@ -193,8 +193,8 @@ class EmbeddingEngine:
         tspec = P(MODEL_AXIS, None)
         rep = P()
 
-        def local_train_step(syn0_l, syn1_l, prob, alias, centers, cmask,
-                             contexts, mask, key, alpha):
+        def step_body(syn0_l, syn1_l, prob, alias, centers, cmask,
+                      contexts, mask, key, alpha):
             # Data-sharded inputs: centers/cmask (Bl, S), contexts/mask
             # (Bl, C). S = subword-group width; word-level training is the
             # S=1 specialization. The center representation is the masked
@@ -253,10 +253,50 @@ class EmbeddingEngine:
 
         self._train_step = jax.jit(
             self._shard_map(
-                local_train_step,
+                step_body,
                 in_specs=(tspec, tspec, rep, rep, P(DATA_AXIS, None),
                           P(DATA_AXIS, None), P(DATA_AXIS, None),
                           P(DATA_AXIS, None), rep, rep),
+                out_specs=(tspec, tspec, rep),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        def local_train_scan(syn0_l, syn1_l, prob, alias, centers_k, cmask_k,
+                             contexts_k, mask_k, base_key, step0, alphas_k):
+            # K stacked minibatches executed by one on-device lax.scan —
+            # one dispatch + one host->device transfer per K steps instead
+            # of per step. Per-step keys are fold_in(base_key, step0 + i),
+            # the same derivation the single-step caller uses, so a scanned
+            # run and a step-at-a-time run of the same schedule draw
+            # identical negatives.
+            def body(carry, xs):
+                s0, s1 = carry
+                centers, cmask, contexts, mask, i, alpha = xs
+                key = jax.random.fold_in(base_key, step0 + i)
+                s0, s1, loss = step_body(
+                    s0, s1, prob, alias, centers, cmask, contexts, mask,
+                    key, alpha,
+                )
+                return (s0, s1), loss
+
+            K = alphas_k.shape[0]
+            (syn0_l, syn1_l), losses = lax.scan(
+                body,
+                (syn0_l, syn1_l),
+                (centers_k, cmask_k, contexts_k, mask_k,
+                 jnp.arange(K, dtype=jnp.uint32), alphas_k),
+            )
+            return syn0_l, syn1_l, losses
+
+        # jit specializes on the leading scan length K.
+        self._train_scan = jax.jit(
+            self._shard_map(
+                local_train_scan,
+                in_specs=(tspec, tspec, rep, rep,
+                          P(None, DATA_AXIS, None), P(None, DATA_AXIS, None),
+                          P(None, DATA_AXIS, None), P(None, DATA_AXIS, None),
+                          rep, rep, rep),
                 out_specs=(tspec, tspec, rep),
             ),
             donate_argnums=(0, 1),
@@ -386,6 +426,53 @@ class EmbeddingEngine:
         )
         self._norms_cache = None
         return loss
+
+    def train_steps(
+        self, centers_k, contexts_k, mask_k, base_key, alphas, step0: int = 0
+    ) -> jax.Array:
+        """K minibatches in ONE device dispatch via an on-device ``lax.scan``.
+
+        ``centers_k (K, B)``, ``contexts_k (K, B, C)``, ``mask_k (K, B, C)``,
+        ``alphas (K,)``. The per-step PRNG key is
+        ``fold_in(base_key, step0 + i)``, so this is step-for-step identical
+        (same negatives, same updates) to K calls of :meth:`train_step` with
+        that key schedule. Returns the (K,) per-step losses.
+
+        This is the dispatch-amortized hot path: the reference pays two RPC
+        round-trips per 50-position minibatch (mllib:421-429); the scanned
+        step pays one host round-trip per K minibatches, with all K updates
+        running back-to-back on device.
+        """
+        centers_k = jnp.asarray(centers_k)
+        K, B = centers_k.shape[0], centers_k.shape[1]
+        return self.train_steps_grouped(
+            centers_k[:, :, None],
+            jnp.ones((K, B, 1), dtype=jnp.float32),
+            contexts_k, mask_k, base_key, alphas, step0,
+        )
+
+    def train_steps_grouped(
+        self, center_groups_k, group_mask_k, contexts_k, mask_k, base_key,
+        alphas, step0: int = 0
+    ) -> jax.Array:
+        """Grouped-center (subword) variant of :meth:`train_steps`:
+        ``center_groups_k (K, B, S)``, ``group_mask_k (K, B, S)``."""
+        B = center_groups_k.shape[1]
+        if B % self.num_data:
+            raise ValueError(
+                f"batch size {B} not divisible by data axis {self.num_data}"
+            )
+        self.syn0, self.syn1, losses = self._train_scan(
+            self.syn0, self.syn1, self._prob, self._alias,
+            jnp.asarray(center_groups_k),
+            jnp.asarray(group_mask_k, dtype=jnp.float32),
+            jnp.asarray(contexts_k),
+            jnp.asarray(mask_k, dtype=jnp.float32),
+            base_key, jnp.uint32(step0),
+            jnp.asarray(alphas, dtype=jnp.float32),
+        )
+        self._norms_cache = None
+        return losses
 
     # ------------------------------------------------------------------
     # Serving ops (the BigWord2VecMatrix query surface)

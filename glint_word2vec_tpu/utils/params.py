@@ -63,6 +63,11 @@ class Word2VecParams:
         (``unigramTableSize``; None = exact alias sampling, see corpus.alias).
       dtype: parameter dtype for the embedding tables ("float32" or
         "bfloat16"). Dots/updates always accumulate in float32.
+      steps_per_call: minibatches executed per device dispatch (an on-device
+        ``lax.scan`` over stacked batches). The TPU analogue of the
+        reference's RPC flow control — it kept ~1 minibatch in flight per
+        worker (mllib:419-429); here each dispatch carries this many, so
+        host round-trip latency amortizes away. 1 = step-at-a-time.
     """
 
     vector_size: int = 100
@@ -80,6 +85,7 @@ class Word2VecParams:
     unigram_power: float = 0.75
     unigram_table_size: int | None = None
     dtype: str = "float32"
+    steps_per_call: int = 16
 
     def __post_init__(self) -> None:
         self.validate()
@@ -102,6 +108,7 @@ class Word2VecParams:
             "unigram_table_size must be > 0 or None",
         )
         _require(self.dtype in ("float32", "bfloat16"), "dtype must be float32|bfloat16")
+        _require(self.steps_per_call > 0, "steps_per_call must be > 0")
 
     def replace(self, **kwargs) -> "Word2VecParams":
         return dataclasses.replace(self, **kwargs)

@@ -216,3 +216,90 @@ def test_destroy_frees_tables():
     eng = _mk_engine(1, 8)
     eng.destroy()
     assert eng.syn0 is None and eng.syn1 is None
+
+
+def test_train_steps_scan_matches_sequential_steps():
+    # K scanned minibatches (one dispatch) must equal K train_step calls
+    # with the fold_in(base_key, step0 + i) key schedule the scan uses.
+    ref = _mk_engine(2, 4)
+    eng = _mk_engine(2, 4)
+    K, B, C = 3, 16, 5
+    rng = np.random.default_rng(9)
+    centers_k = rng.integers(0, V, (K, B)).astype(np.int32)
+    contexts_k = rng.integers(0, V, (K, B, C)).astype(np.int32)
+    mask_k = (rng.random((K, B, C)) < 0.8).astype(np.float32)
+    base_key = jax.random.PRNGKey(21)
+    alphas = np.array([0.05, 0.04, 0.03], np.float32)
+    step0 = 7
+
+    seq_losses = [
+        float(
+            ref.train_step(
+                centers_k[i], contexts_k[i], mask_k[i],
+                jax.random.fold_in(base_key, step0 + i), float(alphas[i]),
+            )
+        )
+        for i in range(K)
+    ]
+    scan_losses = np.asarray(
+        eng.train_steps(centers_k, contexts_k, mask_k, base_key, alphas, step0)
+    )
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(eng.syn0, np.float32)[:V],
+        np.asarray(ref.syn0, np.float32)[:V],
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(eng.syn1, np.float32)[:V],
+        np.asarray(ref.syn1, np.float32)[:V],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_train_steps_grouped_scan_matches_sequential():
+    # Subword (grouped-center) scan path against step-at-a-time.
+    counts = np.arange(V, 0, -1).astype(np.int64) * 10
+    ref = EmbeddingEngine(
+        make_mesh(2, 4), V, D, counts, num_negatives=4, seed=3, extra_rows=8
+    )
+    eng = EmbeddingEngine(
+        make_mesh(2, 4), V, D, counts, num_negatives=4, seed=3, extra_rows=8
+    )
+    K, B, S, C = 2, 8, 3, 5
+    rng = np.random.default_rng(10)
+    groups_k = rng.integers(0, V + 8, (K, B, S)).astype(np.int32)
+    gmask_k = (rng.random((K, B, S)) < 0.9).astype(np.float32)
+    contexts_k = rng.integers(0, V, (K, B, C)).astype(np.int32)
+    mask_k = (rng.random((K, B, C)) < 0.8).astype(np.float32)
+    base_key = jax.random.PRNGKey(2)
+    alphas = np.array([0.05, 0.02], np.float32)
+
+    for i in range(K):
+        ref.train_step_grouped(
+            groups_k[i], gmask_k[i], contexts_k[i], mask_k[i],
+            jax.random.fold_in(base_key, i), float(alphas[i]),
+        )
+    eng.train_steps_grouped(
+        groups_k, gmask_k, contexts_k, mask_k, base_key, alphas, 0
+    )
+    np.testing.assert_allclose(
+        np.asarray(eng.syn0, np.float32)[: V + 8],
+        np.asarray(ref.syn0, np.float32)[: V + 8],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_zero_mask_batch_is_noop():
+    # The fit() epoch-tail padding contract: a batch whose context mask is
+    # all zero must leave both tables bitwise unchanged.
+    eng = _mk_engine(2, 4)
+    s0 = np.asarray(eng.syn0, np.float32).copy()
+    s1 = np.asarray(eng.syn1, np.float32).copy()
+    B, C = 16, 5
+    centers = np.zeros(B, np.int32)
+    contexts = np.zeros((B, C), np.int32)
+    mask = np.zeros((B, C), np.float32)
+    eng.train_step(centers, contexts, mask, jax.random.PRNGKey(0), 0.05)
+    np.testing.assert_array_equal(np.asarray(eng.syn0, np.float32), s0)
+    np.testing.assert_array_equal(np.asarray(eng.syn1, np.float32), s1)
