@@ -192,10 +192,15 @@ class Word2Vec:
         if state_path and os.path.exists(state_path):
             with open(state_path) as f:
                 state = json.load(f)
-            engine.set_tables(
-                np.load(os.path.join(checkpoint_dir, "ckpt", "syn0.npy")),
-                np.load(os.path.join(checkpoint_dir, "ckpt", "syn1.npy")),
-            )
+            if "ckpt" in state:
+                engine.load_tables(
+                    os.path.join(checkpoint_dir, state["ckpt"])
+                )
+            else:  # legacy single-file layout
+                engine.set_tables(
+                    np.load(os.path.join(checkpoint_dir, "ckpt", "syn0.npy")),
+                    np.load(os.path.join(checkpoint_dir, "ckpt", "syn1.npy")),
+                )
             start_epoch = state["epochs_completed"]
             step = state["step"]
             batcher.words_done = state["words_done"]
@@ -207,15 +212,12 @@ class Word2Vec:
         metrics = TrainingMetrics(base_words=batcher.words_done)
 
         def save_checkpoint(epochs_completed: int) -> None:
-            # Atomic: tables first (tmp + rename), state.json last, so a
-            # crash mid-write can never yield a state file pointing at
-            # mismatched tables.
-            ck = os.path.join(checkpoint_dir, "ckpt")
-            os.makedirs(ck, exist_ok=True)
-            for name, table in (("syn0", engine.syn0), ("syn1", engine.syn1)):
-                tmp = os.path.join(ck, f".{name}.tmp.npy")
-                np.save(tmp, np.asarray(table, np.float32)[: engine.num_rows])
-                os.replace(tmp, os.path.join(ck, f"{name}.npy"))
+            # Atomic: the sharded table snapshot lands in a fresh directory
+            # first; state.json (atomic rename) flips to it last, so a crash
+            # mid-write can never yield a state file pointing at mismatched
+            # or partial tables. Older snapshot dirs are pruned after.
+            ck_name = f"ckpt-{epochs_completed}"
+            engine.save(os.path.join(checkpoint_dir, ck_name))
             tmp = state_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(
@@ -223,10 +225,18 @@ class Word2Vec:
                         "epochs_completed": epochs_completed,
                         "step": step,
                         "words_done": batcher.words_done,
+                        "ckpt": ck_name,
                     },
                     f,
                 )
             os.replace(tmp, state_path)
+            import shutil
+
+            for entry in os.listdir(checkpoint_dir):
+                if entry.startswith("ckpt-") and entry != ck_name:
+                    shutil.rmtree(
+                        os.path.join(checkpoint_dir, entry), ignore_errors=True
+                    )
 
         spc = p.steps_per_call
         for epoch in range(start_epoch, p.num_iterations):
@@ -399,6 +409,19 @@ class Word2VecModel:
         results = self.find_synonyms_vector(vec, num + 1)
         return [(w, s) for w, s in results if w != word][:num]
 
+    def _query_engine(self):
+        """Engine whose syn0 answers similarity queries. The word-level
+        model queries the training table directly; subword families override
+        (FastTextModel composes per-word vectors into a second engine)."""
+        return self.engine
+
+    def _decode_hits(self, sims, idx) -> List[Tuple[str, float]]:
+        return [
+            (self.vocab.words[int(i)], float(s))
+            for s, i in zip(sims, idx)
+            if int(i) < self.vocab.size
+        ]
+
     def find_synonyms_vector(
         self, vector: np.ndarray, num: int
     ) -> List[Tuple[str, float]]:
@@ -408,12 +431,25 @@ class Word2VecModel:
         if num <= 0:
             raise ValueError("num must be > 0")
         num = min(num, self.vocab.size)
-        sims, idx = self.engine.top_k_cosine(np.asarray(vector, np.float32), num)
-        return [
-            (self.vocab.words[int(i)], float(s))
-            for s, i in zip(sims, idx)
-            if int(i) < self.vocab.size
-        ]
+        sims, idx = self._query_engine().top_k_cosine(
+            np.asarray(vector, np.float32), num
+        )
+        return self._decode_hits(sims, idx)
+
+    def find_synonyms_batch(
+        self, vectors: np.ndarray, num: int
+    ) -> List[List[Tuple[str, float]]]:
+        """Top-``num`` neighbors for a whole (Q, d) query batch in one
+        distributed dispatch — the batch form of
+        :meth:`find_synonyms_vector` (the reference answers findSynonyms
+        for arrays by looping single queries, ml:375-420)."""
+        if num <= 0:
+            raise ValueError("num must be > 0")
+        num = min(num, self.vocab.size)
+        sims, idx = self._query_engine().top_k_cosine_batch(
+            np.asarray(vectors, np.float32), num
+        )
+        return [self._decode_hits(s, i) for s, i in zip(sims, idx)]
 
     def analogy(
         self, positive: Sequence[str], negative: Sequence[str], num: int

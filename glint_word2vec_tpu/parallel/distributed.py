@@ -1,0 +1,169 @@
+"""Multi-host distributed backend: DCN bring-up, global meshes, host-local
+batch feeding.
+
+Reference mapping (SURVEY.md §2.2, §3.5): the Glint fork's cluster substrate
+is an Akka-remoting actor system spanning a Spark app — a master on the
+driver, servers on executors, workers connecting by host
+(``Client.getHostConfig(parameterServerHost)``, mllib:358-360), launched
+either inside the training app (``Client.runWithWord2VecMatrixOnSpark``,
+mllib:355) or as a standalone cluster app (``glint.Main``, README.md:52-57).
+The TPU-native restatement has no server processes at all:
+
+  * cluster bring-up   -> :func:`initialize` (JAX distributed runtime over
+    DCN: one coordinator, N host processes, each owning its local chips)
+  * PS/worker topology -> :func:`make_global_mesh` (("data", "model") mesh
+    over ALL processes' devices; ICI inside a slice, DCN across slices)
+  * Spark partition feeding its executor -> :func:`process_batch_slice` +
+    :func:`make_global_batch` (each host materializes only its data-axis
+    rows; ``jax.make_array_from_process_local_data`` assembles the global
+    batch without any host ever holding it all)
+  * separate-cluster mode / host override at load -> meshes are
+    reconstructable on any topology; checkpoints re-home freely
+    (engine.load, mllib:696-725 analogue)
+
+Single-process use is the degenerate case throughout: every helper works
+unchanged (and is unit-tested) with ``process_count == 1``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Bring up the JAX distributed runtime (DCN coordination layer).
+
+    The analogue of starting/joining the Glint cluster: where the reference
+    spawns a master + parameter servers and connects by host:port
+    (mllib:354-360; separate-glint.conf ports), TPU pods coordinate through
+    one bootstrap service. With no arguments, TPU pod environments
+    auto-discover topology (the "integrated" deployment, README.md:45-50);
+    explicit arguments are the "separate cluster" analogue (README.md:52-57)
+    for GPU/CPU multi-host or custom launchers.
+
+    Call once per host process, before any other JAX API. No-op if the
+    distributed runtime is already initialized.
+    """
+    import jax
+
+    state = getattr(jax._src.distributed, "global_state", None)
+    if state is not None and state.client is not None:  # already up
+        logger.info("jax.distributed already initialized; skipping")
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def make_global_mesh(
+    num_data: Optional[int] = None, num_model: Optional[int] = None
+):
+    """("data", "model") mesh over ALL hosts' devices.
+
+    Layout policy: the device grid is built from the global device list in
+    process-major order, so with ``num_data >= process_count`` each host's
+    chips form whole data-axis rows — the model axis (the hot psum/all_gather
+    paths, engine._pull_rows/_scatter_rows) stays inside one host's slice and
+    rides ICI, while the data axis alone crosses DCN. That is the same
+    locality split the reference gets from server-side compute: heavy traffic
+    stays server-local; only batch-level exchange crosses the network
+    (SURVEY.md §2.3 comm-backend row).
+    """
+    import jax
+
+    return make_mesh(num_data, num_model, devices=jax.devices())
+
+
+def process_batch_slice(mesh, process_index: Optional[int] = None,
+                        process_count: Optional[int] = None) -> Tuple[float, float]:
+    """This host's fraction [lo, hi) of the global batch's data-axis rows.
+
+    The feeding contract mirrors Spark's partition->executor locality
+    (repartition(numPartitions) at mllib:345): each host's corpus reader
+    produces only the rows its local devices will consume. Returns fractions
+    so callers can slice any global batch size.
+    """
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return pi / pc, (pi + 1) / pc
+
+
+def make_global_batch(mesh, *host_arrays: np.ndarray, data_axis: int = 0):
+    """Assemble global device arrays from per-host batch slices.
+
+    Each process passes its own rows (``global_rows / process_count`` each,
+    along ``data_axis``); the result is a tuple of global ``jax.Array``s
+    sharded over "data" on that axis, with every shard living on the host
+    that produced it — no cross-host copy of batch data, exactly like a
+    Spark partition never leaving its executor until the (index-only) PS
+    traffic. Use ``data_axis=1`` for the stacked (K, B, ...) groups fed to
+    ``EmbeddingEngine.train_steps``. Works unchanged for one process.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = []
+    for a in host_arrays:
+        dims = [None] * a.ndim
+        dims[data_axis] = DATA_AXIS
+        spec = P(*dims)
+        out.append(
+            jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.asarray(a)
+            )
+        )
+    return tuple(out)
+
+
+def shard_sentences_for_process(
+    sentences, process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+):
+    """Partition a sentence list across host processes (round-robin).
+
+    The analogue of ``repartition(numPartitions)`` placing RDD partitions on
+    executors (mllib:345): each host trains on its own corpus slice. Round-
+    robin (not contiguous blocks) so document-ordered corpora spread topical
+    clusters evenly across hosts within every epoch. Every process receives
+    the SAME number of sentences (the remainder ``len % process_count`` is
+    dropped): multi-host SPMD training requires every process to dispatch
+    the same step count, or the program deadlocks at the first collective
+    one host doesn't reach. Equal sentence counts make per-host step counts
+    *near*-equal; the feeding loop must still equalize exactly (pad the
+    short hosts' final groups with zero-mask batches, as fit() already does
+    for epoch tails) before dispatching.
+    """
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    per = len(sentences) // pc
+    return [sentences[i * pc + pi] for i in range(per)]

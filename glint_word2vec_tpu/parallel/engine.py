@@ -380,8 +380,43 @@ class EmbeddingEngine:
                 )
             )
 
+        def make_topk_batch(k: int):
+            def local_topk_batch(table_l, q, norms_l):
+                # q: (Q, d) replicated query batch. Same candidate-merge
+                # scheme as the single-vector kernel, vectorized over Q —
+                # one MXU matmul scores all queries against this shard.
+                start = lax.axis_index(MODEL_AXIS) * Vs
+                kk = min(k, Vs)
+                scores = q @ table_l.astype(jnp.float32).T  # (Q, Vs)
+                safe = jnp.where(norms_l > 0, norms_l, 1.0)
+                is_word = (start + jnp.arange(Vs)) < self.vocab_size
+                cos = jnp.where(
+                    (norms_l > 0) & is_word, scores / safe, -jnp.inf
+                )
+                val, idx = lax.top_k(cos, kk)  # (Q, kk)
+                cand_val = lax.all_gather(
+                    val, MODEL_AXIS, tiled=True, axis=1
+                )
+                cand_idx = lax.all_gather(
+                    idx + start, MODEL_AXIS, tiled=True, axis=1
+                )
+                mval, mpos = lax.top_k(
+                    cand_val, min(k, cand_val.shape[1])
+                )
+                return mval, jnp.take_along_axis(cand_idx, mpos, axis=1)
+
+            return jax.jit(
+                self._shard_map(
+                    local_topk_batch,
+                    in_specs=(tspec, rep, P(MODEL_AXIS)),
+                    out_specs=(rep, rep),
+                )
+            )
+
         self._topk_cache: dict = {}
+        self._topk_batch_cache: dict = {}
         self._make_topk = make_topk
+        self._make_topk_batch = make_topk_batch
         # Lazy norms cache, invalidated by any table mutation — the engine-
         # side analogue of the reference's cached ``wordVecNorms``
         # (mllib:486).
@@ -549,22 +584,85 @@ class EmbeddingEngine:
         )
         return np.asarray(val), np.asarray(idx)
 
+    def top_k_cosine_batch(
+        self, vecs, k: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched :meth:`top_k_cosine`: (Q, d) queries -> ((Q, k) sims,
+        (Q, k) indices) in one distributed dispatch. The batch analogue of
+        the reference's findSynonyms(Array) delegation loop
+        (ml:375-420), scored as one sharded matmul per call."""
+        if not 0 < k <= self.padded_vocab:
+            raise ValueError(f"k must be in [1, {self.padded_vocab}]")
+        q = np.asarray(vecs, dtype=np.float32)
+        if q.ndim != 2 or q.shape[1] != self.dim:
+            raise ValueError(f"vecs must have shape (Q, {self.dim})")
+        nrm = np.linalg.norm(q, axis=1, keepdims=True)
+        q = q / np.where(nrm > 0, nrm, 1.0)
+        if k not in self._topk_batch_cache:
+            self._topk_batch_cache[k] = self._make_topk_batch(k)
+        val, idx = self._topk_batch_cache[k](
+            self.syn0, jnp.asarray(q), self.norms()
+        )
+        return np.asarray(val), np.asarray(idx)
+
     # ------------------------------------------------------------------
     # Persistence / lifecycle
     # ------------------------------------------------------------------
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, mode: str = "sharded") -> None:
         """Write both matrices + engine metadata (Glint ``matrix.save``,
-        mllib:494 — servers flushing shards to HDFS becomes device_get ->
-        npy). Unpadded rows only; a future-mesh load re-pads freely."""
+        mllib:494 — each server flushing its shard to HDFS becomes each
+        mesh slice flushing its row block).
+
+        ``mode="sharded"`` (default) writes one ``.npy`` per owned model-axis
+        row block — no host ever materializes a full table (the save-side
+        analogue of killing the 8 GB broadcast ceiling, README.md:71-73),
+        and under multi-host each process writes only its addressable
+        shards. ``mode="single"`` writes one full-table file (handy for
+        small models / interop). Both re-load onto any mesh shape.
+        """
         os.makedirs(path, exist_ok=True)
-        syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.num_rows]
-        syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.num_rows]
-        np.save(os.path.join(path, "syn0.npy"), syn0)
-        np.save(os.path.join(path, "syn1.npy"), syn1)
-        counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
-        np.save(os.path.join(path, "counts.npy"), counts)
+        shard_files = {"syn0": [], "syn1": []}
+        if mode == "sharded":
+            # The manifest is deterministic from mesh geometry (identical on
+            # every process); files are written only by a process that can
+            # address the block, each block by exactly one process.
+            for name, table in (("syn0", self.syn0), ("syn1", self.syn1)):
+                for k in range(self.num_model):
+                    start = k * self.rows_per_shard
+                    stop = min(start + self.rows_per_shard, self.num_rows)
+                    if start >= stop:
+                        continue  # pure-padding block
+                    fname = f"{name}.r{start:012d}.npy"
+                    shard_files[name].append(
+                        {"file": fname, "start": start, "stop": stop}
+                    )
+                for shard in table.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue  # replica 0 of each block writes, once
+                    start = shard.index[0].start or 0
+                    if start >= self.num_rows:
+                        continue
+                    stop = min(start + self.rows_per_shard, self.num_rows)
+                    block = np.asarray(shard.data, dtype=np.float32)[
+                        : stop - start
+                    ]
+                    np.save(
+                        os.path.join(path, f"{name}.r{start:012d}.npy"), block
+                    )
+        else:
+            if mode != "single":
+                raise ValueError("mode must be 'sharded' or 'single'")
+            if jax.process_index() == 0:
+                syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.num_rows]
+                syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.num_rows]
+                np.save(os.path.join(path, "syn0.npy"), syn0)
+                np.save(os.path.join(path, "syn1.npy"), syn1)
+        if jax.process_index() == 0:
+            counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
+            np.save(os.path.join(path, "counts.npy"), counts)
         meta = {
+            "format": mode,
             "vocab_size": self.vocab_size,
             "dim": self.dim,
             "num_negatives": self.num_negatives,
@@ -573,8 +671,13 @@ class EmbeddingEngine:
             "extra_rows": self.num_rows - self.vocab_size,
             "dtype": "bfloat16" if self._dtype == jnp.bfloat16 else "float32",
         }
-        with open(os.path.join(path, "engine.json"), "w") as f:
-            json.dump(meta, f)
+        if mode == "sharded":
+            meta["shards"] = shard_files
+        # Multi-host: every process wrote disjoint shard files; exactly one
+        # writes the manifest (it is deterministic from mesh geometry).
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "engine.json"), "w") as f:
+                json.dump(meta, f)
 
     def _counts_unpadded(self) -> np.ndarray:
         # Recover counts from the alias table is lossy; engines keep them.
@@ -584,7 +687,9 @@ class EmbeddingEngine:
     def load(cls, path: str, mesh, **overrides) -> "EmbeddingEngine":
         """Rebuild an engine from :meth:`save` output onto any mesh shape —
         the analogue of re-homing a saved model onto a different PS cluster
-        (mllib:696-725, ml:584-586)."""
+        (mllib:696-725, ml:584-586). The source and target mesh shapes are
+        independent: sharded files are re-sliced to whatever row blocks the
+        new mesh owns, streamed via mmap (no full-table host copy)."""
         with open(os.path.join(path, "engine.json")) as f:
             meta = json.load(f)
         counts = np.load(os.path.join(path, "counts.npy"))
@@ -603,10 +708,63 @@ class EmbeddingEngine:
             dtype=overrides.get("dtype", meta["dtype"]),
             extra_rows=meta.get("extra_rows", 0),
         )
-        syn0 = np.load(os.path.join(path, "syn0.npy"))
-        syn1 = np.load(os.path.join(path, "syn1.npy"))
-        eng.set_tables(syn0, syn1)
+        eng.load_tables(path)
         return eng
+
+    def load_tables(self, path: str) -> None:
+        """Install table values from a :meth:`save` directory (either
+        format) into this engine, re-sharding to its mesh. Each device
+        shard is assembled independently from the overlapping source row
+        blocks (mmap-sliced), so peak host memory is one shard, not one
+        table."""
+        with open(os.path.join(path, "engine.json")) as f:
+            meta = json.load(f)
+        if (meta["vocab_size"], meta.get("extra_rows", 0)) != (
+            self.vocab_size, self.num_rows - self.vocab_size
+        ) or meta["dim"] != self.dim:
+            raise ValueError(
+                f"checkpoint at {path} has geometry "
+                f"(V={meta['vocab_size']}, extra={meta.get('extra_rows', 0)}, "
+                f"d={meta['dim']}), engine has (V={self.vocab_size}, "
+                f"extra={self.num_rows - self.vocab_size}, d={self.dim})"
+            )
+        fmt = meta.get("format", "single")
+        tsh = table_sharding(self.mesh)
+        for name in ("syn0", "syn1"):
+            if fmt == "sharded":
+                blocks = [
+                    (
+                        b["start"],
+                        b["stop"],
+                        np.load(os.path.join(path, b["file"]), mmap_mode="r"),
+                    )
+                    for b in meta["shards"][name]
+                ]
+            else:
+                arr = np.load(os.path.join(path, f"{name}.npy"), mmap_mode="r")
+                blocks = [(0, arr.shape[0], arr)]
+
+            def assemble(index, _blocks=blocks):
+                row_sl = index[0]
+                start = row_sl.start or 0
+                stop = row_sl.stop if row_sl.stop is not None else self.padded_vocab
+                out = np.zeros((stop - start, self.dim), np.float32)
+                for bstart, bstop, data in _blocks:
+                    lo, hi = max(start, bstart), min(stop, bstop)
+                    if lo < hi:
+                        out[lo - start : hi - start] = data[
+                            lo - bstart : hi - bstart
+                        ]
+                return out.astype(self._dtype)
+
+            setattr(
+                self,
+                name,
+                jax.make_array_from_callback(
+                    (self.padded_vocab, self.dim), tsh, assemble
+                ),
+            )
+        self._norms_cache = None
 
     def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:
         """Install host table values (unpadded, all num_rows rows),

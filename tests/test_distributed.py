@@ -1,0 +1,71 @@
+"""Multi-host backend helpers, exercised in their single-process degenerate
+form (the only form testable without multiple host processes; the sharding
+they produce is identical in kind to the multi-process case).
+"""
+
+import numpy as np
+import jax
+
+from glint_word2vec_tpu.parallel.distributed import (
+    make_global_batch,
+    make_global_mesh,
+    process_batch_slice,
+    shard_sentences_for_process,
+)
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+def test_make_global_mesh_uses_all_devices():
+    mesh = make_global_mesh(2, 4)
+    assert mesh.shape == {"data": 2, "model": 4}
+
+
+def test_process_batch_slice_fractions():
+    mesh = make_mesh(2, 4)
+    assert process_batch_slice(mesh, 0, 4) == (0.0, 0.25)
+    assert process_batch_slice(mesh, 3, 4) == (0.75, 1.0)
+    assert process_batch_slice(mesh) == (0.0, 1.0)  # single process
+
+
+def test_shard_sentences_round_robin_equal_slices():
+    sents = [[f"w{i}"] for i in range(10)]
+    s0 = shard_sentences_for_process(sents, 0, 3)
+    s1 = shard_sentences_for_process(sents, 1, 3)
+    s2 = shard_sentences_for_process(sents, 2, 3)
+    # Equal slice sizes (remainder dropped): multi-host SPMD requires every
+    # process to dispatch the same number of steps.
+    assert len(s0) == len(s1) == len(s2) == 3
+    assert [s[0] for s in s0] == ["w0", "w3", "w6"]
+    assert [s[0] for s in s1] == ["w1", "w4", "w7"]
+    assert shard_sentences_for_process(sents, 0, 1) == sents
+
+
+def test_make_global_batch_shards_on_data_axis():
+    mesh = make_mesh(4, 2)
+    B, C = 16, 5
+    centers = np.arange(B, dtype=np.int32)
+    contexts = np.zeros((B, C), np.int32)
+    (gc, gx) = make_global_batch(mesh, centers, contexts)
+    assert gc.shape == (B,)
+    assert gc.sharding.spec == jax.sharding.PartitionSpec(DATA_AXIS)
+    np.testing.assert_array_equal(np.asarray(gc), centers)
+    assert gx.sharding.spec == jax.sharding.PartitionSpec(DATA_AXIS, None)
+
+
+def test_global_batch_feeds_train_steps():
+    # Stacked (K, B, ...) group sharded on axis 1 drives the scanned step.
+    mesh = make_mesh(4, 2)
+    V, D = 40, 8
+    counts = np.arange(V, 0, -1).astype(np.int64)
+    eng = EmbeddingEngine(mesh, V, D, counts, num_negatives=2, seed=0)
+    K, B, C = 2, 8, 3
+    rng = np.random.default_rng(0)
+    ck = rng.integers(0, V, (K, B)).astype(np.int32)
+    xk = rng.integers(0, V, (K, B, C)).astype(np.int32)
+    mk = (rng.random((K, B, C)) < 0.8).astype(np.float32)
+    gck, gxk, gmk = make_global_batch(mesh, ck, xk, mk, data_axis=1)
+    losses = eng.train_steps(
+        gck, gxk, gmk, jax.random.PRNGKey(0), np.full(K, 0.05, np.float32)
+    )
+    assert np.all(np.isfinite(np.asarray(losses)))

@@ -303,3 +303,54 @@ def test_zero_mask_batch_is_noop():
     eng.train_step(centers, contexts, mask, jax.random.PRNGKey(0), 0.05)
     np.testing.assert_array_equal(np.asarray(eng.syn0, np.float32), s0)
     np.testing.assert_array_equal(np.asarray(eng.syn1, np.float32), s1)
+
+
+def test_sharded_save_writes_per_shard_files_and_reloads(tmp_path):
+    # Sharded save: one row-block file per model shard, manifest in
+    # engine.json, reload onto a *different* mesh shape bit-exact.
+    eng = _mk_engine(2, 4)
+    centers, contexts, mask = _batch(B=16, C=5, seed=7)
+    eng.train_step(centers, contexts, mask, jax.random.PRNGKey(3), 0.05)
+    path = str(tmp_path / "m")
+    eng.save(path)  # default sharded
+    import json as _json
+
+    files = sorted(os.listdir(path))
+    assert "syn0.npy" not in files  # no full-table file
+    assert sum(f.startswith("syn0.r") for f in files) == 4
+    with open(os.path.join(path, "engine.json")) as f:
+        meta = _json.load(f)
+    assert meta["format"] == "sharded"
+    assert len(meta["shards"]["syn1"]) == 4
+
+    eng2 = EmbeddingEngine.load(path, make_mesh(8, 1))
+    np.testing.assert_array_equal(
+        np.asarray(eng.syn0, np.float32)[:V],
+        np.asarray(eng2.syn0, np.float32)[:V],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eng.syn1, np.float32)[:V],
+        np.asarray(eng2.syn1, np.float32)[:V],
+    )
+
+
+def test_single_mode_save_still_loads(tmp_path):
+    eng = _mk_engine(1, 8)
+    path = str(tmp_path / "m")
+    eng.save(path, mode="single")
+    assert os.path.exists(os.path.join(path, "syn0.npy"))
+    eng2 = EmbeddingEngine.load(path, make_mesh(2, 4))
+    np.testing.assert_array_equal(
+        np.asarray(eng.syn0, np.float32)[:V],
+        np.asarray(eng2.syn0, np.float32)[:V],
+    )
+
+
+def test_load_tables_geometry_mismatch_raises(tmp_path):
+    eng = _mk_engine(1, 8)
+    path = str(tmp_path / "m")
+    eng.save(path)
+    counts = np.arange(V + 1, 0, -1).astype(np.int64)
+    other = EmbeddingEngine(make_mesh(1, 8), V + 1, D, counts, seed=0)
+    with pytest.raises(ValueError, match="geometry"):
+        other.load_tables(path)
