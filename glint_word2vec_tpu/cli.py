@@ -66,6 +66,15 @@ def _add_query(sub):
     p = sub.add_parser("info", help="model metadata")
     p.add_argument("--model", required=True)
 
+    p = sub.add_parser(
+        "eval", help="analogy accuracy on a standard question file"
+    )
+    p.add_argument("--model", required=True)
+    p.add_argument("--questions", required=True,
+                   help="': section' headers + 'a b c d' rows")
+    p.add_argument("--top-k", type=int, default=1)
+    p.add_argument("--no-lowercase", action="store_true")
+
 
 def main(argv=None) -> int:
     logging.basicConfig(
@@ -126,6 +135,14 @@ def _run(args) -> int:
     elif args.cmd == "transform":
         vec = model.transform_sentences([args.sentence.split()])[0]
         print(json.dumps([round(float(x), 6) for x in vec]))
+    elif args.cmd == "eval":
+        from glint_word2vec_tpu.eval import evaluate_analogies, parse_analogy_file
+
+        questions = parse_analogy_file(
+            args.questions, lowercase=not args.no_lowercase
+        )
+        result = evaluate_analogies(model, questions, top_k=args.top_k)
+        print(json.dumps(result.to_dict()))
     elif args.cmd == "info":
         print(
             json.dumps(

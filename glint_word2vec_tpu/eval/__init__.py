@@ -1,0 +1,13 @@
+from glint_word2vec_tpu.eval.analogy import (
+    AnalogyResult,
+    evaluate_analogies,
+    evaluate_synonym_gate,
+    parse_analogy_file,
+)
+
+__all__ = [
+    "AnalogyResult",
+    "evaluate_analogies",
+    "evaluate_synonym_gate",
+    "parse_analogy_file",
+]

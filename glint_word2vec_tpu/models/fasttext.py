@@ -238,19 +238,6 @@ class FastTextModel(Word2VecModel):
             self._qeng = qeng
         return self._qeng
 
-    def find_synonyms_vector(self, vector, num: int) -> List[Tuple[str, float]]:
-        if num <= 0:
-            raise ValueError("num must be > 0")
-        num = min(num, self.vocab.size)
-        sims, idx = self._query_engine().top_k_cosine(
-            np.asarray(vector, np.float32), num
-        )
-        return [
-            (self.vocab.words[int(i)], float(s))
-            for s, i in zip(sims, idx)
-            if int(i) < self.vocab.size
-        ]
-
     def to_local(self) -> LocalWord2VecModel:
         qeng = self._query_engine()
         vecs = np.empty((self.vocab.size, self.vector_size), np.float32)
