@@ -11,7 +11,8 @@ Prints exactly one JSON line:
 
 Environment knobs (for smoke-testing on CPU):
   BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_STEPS, BENCH_PLATFORM,
-  BENCH_SPC (minibatches per device dispatch — the scan length)
+  BENCH_SPC (minibatches per device dispatch — the scan length),
+  BENCH_SHARED_NEG (shared noise-pool size; 0 = per-pair draws)
 """
 
 import json
@@ -40,6 +41,9 @@ def main() -> None:
     B = int(os.environ.get("BENCH_BATCH", 8192))
     steps = int(os.environ.get("BENCH_STEPS", 64))
     spc = int(os.environ.get("BENCH_SPC", 32))  # minibatches per dispatch
+    # Shared noise-pool size (the TPU-shaped estimator; see
+    # Word2VecParams.shared_negatives). 0 benches per-pair draws.
+    shared = int(os.environ.get("BENCH_SHARED_NEG", 4096))
     C, n = 7, 5  # window=5 context lanes, 5 negatives (reference defaults)
     steps = (steps // spc) * spc or spc
 
@@ -48,7 +52,10 @@ def main() -> None:
     counts = np.maximum((1e9 / ranks), 1.0).astype(np.int64)
 
     mesh = make_mesh(1, 1, devices=jax.devices()[:1])
-    eng = EmbeddingEngine(mesh, V, d, counts, num_negatives=n, seed=0)
+    eng = EmbeddingEngine(
+        mesh, V, d, counts, num_negatives=n, seed=0,
+        shared_negatives=shared,
+    )
 
     rng = np.random.default_rng(0)
     # Zipf-distributed center/context draws (the hot rows dominate, as in

@@ -41,6 +41,11 @@ def _add_train(sub):
     p.add_argument("--num-shards", type=int, default=1,
                    help="model-parallel mesh axis (reference numParameterServers)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--steps-per-call", type=int, default=16,
+                   help="minibatches per device dispatch (on-device scan)")
+    p.add_argument("--shared-negatives", type=int, default=0,
+                   help="shared noise-pool size per step "
+                        "(0 = per-pair reference semantics)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--metrics-out", default=None,
@@ -116,6 +121,8 @@ def _run(args) -> int:
             num_partitions=args.num_partitions,
             num_shards=args.num_shards,
             dtype=args.dtype,
+            steps_per_call=args.steps_per_call,
+            shared_negatives=args.shared_negatives,
         )
         model = w2v.fit(sentences, checkpoint_dir=args.checkpoint_dir)
         model.save(args.output)

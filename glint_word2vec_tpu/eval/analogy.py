@@ -135,7 +135,8 @@ def evaluate_analogies(
                 ][:top_k]
                 sec_correct += int(d in answers)
                 sec_total += 1
-        res.sections[name] = (sec_correct, sec_total)
+        prev_c, prev_t = res.sections.get(name, (0, 0))
+        res.sections[name] = (prev_c + sec_correct, prev_t + sec_total)
         res.correct += sec_correct
         res.total += sec_total
     return res

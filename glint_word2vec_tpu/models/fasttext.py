@@ -87,6 +87,7 @@ class FastTextWord2Vec(Word2Vec):
             seed=p.seed,
             dtype=p.dtype,
             extra_rows=p.bucket,
+            shared_negatives=p.shared_negatives,
         )
 
     def _train_batches(self, engine, batches, base_key, step0, alphas):

@@ -116,6 +116,14 @@ class Word2Vec:
     def set_dtype(self, v: str) -> "Word2Vec":
         return self._set(dtype=v)
 
+    def set_steps_per_call(self, v: int) -> "Word2Vec":
+        return self._set(steps_per_call=v)
+
+    def set_shared_negatives(self, v: int) -> "Word2Vec":
+        """Shared noise-pool size per step (0 = per-pair reference
+        semantics; see Word2VecParams.shared_negatives)."""
+        return self._set(shared_negatives=v)
+
     # ------------------------------------------------------------------
 
     def _make_mesh(self):
@@ -317,6 +325,7 @@ class Word2Vec:
             unigram_table_size=p.unigram_table_size,
             seed=p.seed,
             dtype=p.dtype,
+            shared_negatives=p.shared_negatives,
         )
 
     def _train_batches(self, engine, batches, base_key, step0, alphas):

@@ -107,6 +107,87 @@ def negative_mask(
     return keep * mask[..., None]
 
 
+class SharedSgnsGrads(NamedTuple):
+    """Gradient pieces for the shared-negative-pool estimator."""
+
+    c_pos: jax.Array  # (B, C)  alpha * (1 - sigmoid(f_pos)) * mask
+    c_pool: jax.Array  # (B, S)  weighted negative coefficients per center
+    d_center: jax.Array  # (B, d)
+    d_pool: jax.Array  # (S, d)  dense update for the pool's syn1 rows
+    loss: jax.Array  # ()
+
+
+def shared_sgns_grads(
+    h: jax.Array,  # (B, d) float32 — syn0 rows of the centers
+    u_pos: jax.Array,  # (B, C, d) float32 — syn1 rows of the contexts
+    u_pool: jax.Array,  # (S, d) float32 — syn1 rows of the shared pool
+    mask: jax.Array,  # (B, C) float32
+    collide: jax.Array,  # (B, S) float32 — 1.0 where pool word hits one of
+    #   the center's real context words (excluded, word2vec's target==word
+    #   skip applied pool-wide)
+    alpha: jax.Array,  # () float32
+    num_negatives: int,  # n — the per-pair draw count being emulated
+) -> SharedSgnsGrads:
+    """SGNS gradients with one shared negative pool per step.
+
+    The TPU-first restatement of negative sampling: the reference draws
+    ``n`` fresh negatives per (center, context) pair server-side
+    (``dotprod``'s seeded draws, mllib:420-421) — on TPU that becomes a
+    gather of B*C*n arbitrary rows, a bandwidth-bound sparse access. Here
+    each step draws ONE pool of S negatives shared by the whole batch and
+    weights every center's pool term by ``m_i * n / S`` (m_i = its real
+    context count): an unbiased Monte-Carlo estimator of the same expected
+    NCE gradient (each pair still sees n expected noise draws from the
+    same unigram^0.75 distribution), usually with *lower* variance since
+    S >> n. All pool compute is dense:
+
+        f_pool = h @ u_pool.T          (B, S)  MXU
+        d_pool = c_pool.T @ h          (S, d)  MXU
+        d_center += c_pool @ u_pool    (B, d)  MXU
+
+    so the only sparse traffic left is the centers and positive contexts.
+    """
+    f_pos = jnp.einsum("bd,bcd->bc", h, u_pos)  # (B, C)
+    f_pool = h @ u_pool.T  # (B, S)
+    s_pos = jax.nn.sigmoid(f_pos)
+    s_pool = jax.nn.sigmoid(f_pool)
+
+    m_i = mask.sum(axis=1)  # (B,) real context count per center
+    S = u_pool.shape[0]
+    keep = 1.0 - collide
+    weight = (m_i * (num_negatives / S))[:, None] * keep  # (B, S)
+
+    c_pos = alpha * (1.0 - s_pos) * mask
+    c_pool = -alpha * s_pool * weight
+
+    d_center = jnp.einsum("bc,bcd->bd", c_pos, u_pos) + c_pool @ u_pool
+    d_pool = c_pool.T @ h  # (S, d)
+
+    log_sig = jax.nn.log_sigmoid
+    pos_loss = (-log_sig(f_pos) * mask).sum()
+    pool_loss = (-log_sig(-f_pool) * weight).sum()
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (pos_loss + pool_loss) / denom
+    return SharedSgnsGrads(
+        c_pos=c_pos, c_pool=c_pool, d_center=d_center, d_pool=d_pool,
+        loss=loss,
+    )
+
+
+def pool_collision_mask(
+    pool: jax.Array,  # (S,) int32 — shared negative pool
+    contexts: jax.Array,  # (B, C) int32
+    mask: jax.Array,  # (B, C) float32
+) -> jax.Array:
+    """(B, S) mask, 1.0 where a pool word equals one of that row's real
+    context words — the pool-wide generalization of the per-draw
+    ``target == word`` skip (see :func:`negative_mask`)."""
+    hits = (pool[None, None, :] == contexts[..., None]) & (
+        mask[..., None] > 0
+    )  # (B, C, S)
+    return hits.any(axis=1).astype(jnp.float32)
+
+
 def train_step(
     syn0: jax.Array,  # (V, d)
     syn1: jax.Array,  # (V, d)

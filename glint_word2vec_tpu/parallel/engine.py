@@ -117,6 +117,7 @@ class EmbeddingEngine:
         seed: int = 1,
         dtype: str = "float32",
         extra_rows: int = 0,
+        shared_negatives: int = 0,
     ):
         """``extra_rows`` appends non-vocabulary rows to both tables (e.g.
         fastText char-ngram buckets, models/fasttext.py): they are trained
@@ -129,11 +130,16 @@ class EmbeddingEngine:
             raise ValueError("counts must have shape (vocab_size,)")
         if extra_rows < 0:
             raise ValueError("extra_rows must be >= 0")
+        if shared_negatives < 0:
+            raise ValueError("shared_negatives must be >= 0")
         self.mesh = mesh
         self.vocab_size = int(vocab_size)
         self.num_rows = int(vocab_size) + int(extra_rows)
         self.dim = int(dim)
         self.num_negatives = int(num_negatives)
+        #: Shared-pool size S per step; 0 = per-pair draws (reference
+        #: semantics). See ops.sgns.shared_sgns_grads for the estimator.
+        self.shared_negatives = int(shared_negatives)
         self.unigram_power = float(unigram_power)
         self.unigram_table_size = unigram_table_size
         self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
@@ -204,11 +210,6 @@ class EmbeddingEngine:
             C = contexts.shape[1]
             start = lax.axis_index(MODEL_AXIS) * Vs
             drank = lax.axis_index(DATA_AXIS)
-            # Mesh-invariant negatives: draw for the full global batch from
-            # the shared key, slice this rank's rows (see module docstring).
-            B = Bl * self.num_data
-            negs_full = sample_negatives(key, prob, alias, (B, C, n))
-            negs = lax.dynamic_slice_in_dim(negs_full, drank * Bl, Bl, axis=0)
 
             h_rows = _pull_rows(syn0_l, centers.reshape(-1), start, Vs)
             h_rows = h_rows.reshape(Bl, S, -1)
@@ -216,30 +217,73 @@ class EmbeddingEngine:
             h = (h_rows * cmask[..., None]).sum(axis=1) / cnt
             u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs)
             u_pos = u_pos.reshape(Bl, C, -1)
-            u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs)
-            u_neg = u_neg.reshape(Bl, C, n, -1)
-            nmask = sgns.negative_mask(negs, contexts, mask)
-            g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
-                                alpha.astype(jnp.float32))
 
-            # Rank-1 update payloads (the reference's gPlus/gMinus scalars
-            # expanded client-side, mllib:422-425). The center gradient is
-            # distributed over the group's rows (d mean / d row = 1/count).
-            d_upos = g.c_pos[..., None] * h[:, None, :]
-            d_uneg = g.c_neg[..., None] * h[:, None, None, :]
+            if self.shared_negatives:
+                # Shared-pool mode: ONE pool of P negatives per step,
+                # identical on every rank (drawn from the shared key — the
+                # mesh-invariance contract needs no slicing here), scored
+                # and updated by dense MXU matmuls instead of B*C*n sparse
+                # row accesses (ops.sgns.shared_sgns_grads).
+                pool = sample_negatives(
+                    key, prob, alias, (self.shared_negatives,)
+                )
+                u_pool = _pull_rows(syn1_l, pool, start, Vs)
+                collide = sgns.pool_collision_mask(pool, contexts, mask)
+                g = sgns.shared_sgns_grads(
+                    h, u_pos, u_pool, mask, collide,
+                    alpha.astype(jnp.float32), n,
+                )
+                d_upos = g.c_pos[..., None] * h[:, None, :]
+                # The pool update sums contributions from every data rank;
+                # after the psum it is identical everywhere, so each model
+                # shard applies its owned slice exactly once per replica.
+                d_pool = lax.psum(g.d_pool, DATA_AXIS)
+                ids1 = lax.all_gather(
+                    contexts.reshape(-1), DATA_AXIS, tiled=True
+                )
+                upd1 = lax.all_gather(
+                    d_upos.reshape(Bl * C, -1), DATA_AXIS, tiled=True
+                )
+                ids1_g = jnp.concatenate([ids1, pool])
+                upd1_g = jnp.concatenate([upd1, d_pool])
+            else:
+                # Per-pair mode (reference semantics): n fresh negatives per
+                # (center, context) pair. Mesh-invariant draws: the full
+                # global batch's negatives come from the shared key; each
+                # rank slices its rows (see module docstring).
+                B = Bl * self.num_data
+                negs_full = sample_negatives(key, prob, alias, (B, C, n))
+                negs = lax.dynamic_slice_in_dim(
+                    negs_full, drank * Bl, Bl, axis=0
+                )
+                u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs)
+                u_neg = u_neg.reshape(Bl, C, n, -1)
+                nmask = sgns.negative_mask(negs, contexts, mask)
+                g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
+                                    alpha.astype(jnp.float32))
+
+                # Rank-1 update payloads (the reference's gPlus/gMinus
+                # scalars expanded client-side, mllib:422-425).
+                d_upos = g.c_pos[..., None] * h[:, None, :]
+                d_uneg = g.c_neg[..., None] * h[:, None, None, :]
+                ids1 = jnp.concatenate(
+                    [contexts.reshape(-1), negs.reshape(-1)]
+                )
+                upd1 = jnp.concatenate(
+                    [d_upos.reshape(Bl * C, -1),
+                     d_uneg.reshape(Bl * C * n, -1)]
+                )
+                ids1_g = lax.all_gather(ids1, DATA_AXIS, tiled=True)
+                upd1_g = lax.all_gather(upd1, DATA_AXIS, tiled=True)
+
+            # The center gradient is distributed over the group's rows
+            # (d mean / d row = 1/count); exchange across the data axis,
+            # then each shard applies the slice it owns.
             d_sub = (g.d_center / cnt)[:, None, :] * cmask[..., None]
-            ids1 = jnp.concatenate([contexts.reshape(-1), negs.reshape(-1)])
-            upd1 = jnp.concatenate(
-                [d_upos.reshape(Bl * C, -1), d_uneg.reshape(Bl * C * n, -1)]
-            )
-            # Exchange updates across the data axis, then each shard applies
-            # the slice it owns.
             ids0_g = lax.all_gather(centers.reshape(-1), DATA_AXIS, tiled=True)
             upd0_g = lax.all_gather(
                 d_sub.reshape(Bl * S, -1), DATA_AXIS, tiled=True
             )
-            ids1_g = lax.all_gather(ids1, DATA_AXIS, tiled=True)
-            upd1_g = lax.all_gather(upd1, DATA_AXIS, tiled=True)
             syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs)
             syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs)
 
@@ -670,6 +714,7 @@ class EmbeddingEngine:
             "unigram_table_size": self.unigram_table_size,
             "extra_rows": self.num_rows - self.vocab_size,
             "dtype": "bfloat16" if self._dtype == jnp.bfloat16 else "float32",
+            "shared_negatives": self.shared_negatives,
         }
         if mode == "sharded":
             meta["shards"] = shard_files
@@ -707,6 +752,9 @@ class EmbeddingEngine:
             ),
             dtype=overrides.get("dtype", meta["dtype"]),
             extra_rows=meta.get("extra_rows", 0),
+            shared_negatives=overrides.get(
+                "shared_negatives", meta.get("shared_negatives", 0)
+            ),
         )
         eng.load_tables(path)
         return eng

@@ -68,6 +68,13 @@ class Word2VecParams:
         reference's RPC flow control — it kept ~1 minibatch in flight per
         worker (mllib:419-429); here each dispatch carries this many, so
         host round-trip latency amortizes away. 1 = step-at-a-time.
+      shared_negatives: 0 (default) draws ``num_negatives`` fresh noise
+        words per (center, context) pair — the reference's server-side
+        semantics (mllib:420-421). > 0 draws ONE pool of this many noise
+        words per step, shared across the batch and weighted to the same
+        expected gradient (ops.sgns.shared_sgns_grads) — the TPU-shaped
+        estimator: dense MXU matmuls instead of batch*contexts*n sparse
+        row accesses. 1024-8192 are typical pool sizes.
     """
 
     vector_size: int = 100
@@ -86,6 +93,7 @@ class Word2VecParams:
     unigram_table_size: int | None = None
     dtype: str = "float32"
     steps_per_call: int = 16
+    shared_negatives: int = 0
 
     def __post_init__(self) -> None:
         self.validate()
@@ -109,6 +117,7 @@ class Word2VecParams:
         )
         _require(self.dtype in ("float32", "bfloat16"), "dtype must be float32|bfloat16")
         _require(self.steps_per_call > 0, "steps_per_call must be > 0")
+        _require(self.shared_negatives >= 0, "shared_negatives must be >= 0")
 
     def replace(self, **kwargs) -> "Word2VecParams":
         return dataclasses.replace(self, **kwargs)
