@@ -36,6 +36,8 @@ __all__ = [
     "FastTextWord2Vec",
     "FastTextModel",
     "FastTextParams",
+    "ServerSideGlintWord2Vec",
+    "ServerSideGlintWord2VecModel",
 ]
 
 
@@ -53,4 +55,10 @@ def __getattr__(name):
         from glint_word2vec_tpu.utils.params import Word2VecParams
 
         return Word2VecParams
+    if name in ("ServerSideGlintWord2Vec", "ServerSideGlintWord2VecModel"):
+        # Reference-surface compatibility layer (compat.py): the PySpark
+        # binding API re-exposed over this framework.
+        from glint_word2vec_tpu import compat
+
+        return getattr(compat, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

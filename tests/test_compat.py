@@ -1,0 +1,118 @@
+"""Reference-surface compatibility layer: the PySpark binding API
+(ml_glintword2vec.py) over the TPU framework. Mirrors the shape of the
+reference's doctest example (ml_glintword2vec.py:54-95): construct with
+camelCase params, fit on tokenized sentences, query synonyms both ways,
+persist, reload, stop.
+"""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu import (
+    ServerSideGlintWord2Vec,
+    ServerSideGlintWord2VecModel,
+)
+
+
+@pytest.fixture(scope="module")
+def fitted(tiny_corpus):
+    est = ServerSideGlintWord2Vec(
+        vectorSize=48,
+        seed=1,
+        numPartitions=2,
+        numParameterServers=4,
+        maxIter=6,
+        stepSize=0.025,
+        batchSize=256,
+        windowSize=5,
+        unigramTableSize=100_000,
+    )
+    model = est.fit(tiny_corpus)
+    yield model
+    model.stop()
+
+
+def test_camelcase_setters_and_getters():
+    est = ServerSideGlintWord2Vec()
+    assert est.getVectorSize() == 100
+    assert est.getStepSize() == 0.01875
+    assert est.getBatchSize() == 50
+    assert est.getN() == 5
+    assert est.getMaxIter() == 1
+    assert est.getNumParameterServers() == 5
+    est.setVectorSize(64).setWindowSize(3).setN(7)
+    assert est.getVectorSize() == 64
+    assert est.getWindowSize() == 3
+    assert est.getN() == 7
+    est.setParams(minCount=2, maxSentenceLength=100)
+    assert est.getMinCount() == 2
+    assert est.getMaxSentenceLength() == 100
+
+
+def test_topology_clamped_to_devices(tiny_corpus, recwarn):
+    # 8 virtual devices; the reference default of 5 servers doesn't divide
+    # them — the compat layer clamps like the reference adapts to its
+    # cluster size.
+    est = ServerSideGlintWord2Vec(
+        vectorSize=16, maxIter=1, batchSize=64, seed=1, minCount=5,
+        numParameterServers=5, numPartitions=3, unigramTableSize=1000,
+    )
+    m = est.fit(tiny_corpus[:500])
+    assert any("clamped" in str(w.message) for w in recwarn.list)
+    m.stop()
+
+
+def test_unknown_param_rejected():
+    est = ServerSideGlintWord2Vec()
+    with pytest.raises(TypeError, match="numIterations"):
+        est.setParams(numIterations=5)  # mllib-dialect name, not a param
+    with pytest.raises(TypeError, match="vectorSzie"):
+        ServerSideGlintWord2Vec(vectorSzie=10)  # typo fails in the ctor too
+
+
+def test_save_refuses_overwrite(fitted, tmp_path):
+    path = str(tmp_path / "m")
+    fitted.save(path)
+    with pytest.raises(FileExistsError, match="overwrite"):
+        fitted.save(path)
+    fitted.write().overwrite().save(path)  # explicit overwrite allowed
+
+
+def test_parameter_server_host_rejected(tiny_corpus):
+    est = ServerSideGlintWord2Vec(parameterServerHost="10.0.0.1")
+    with pytest.raises(ValueError, match="parameterServerHost"):
+        est.fit(tiny_corpus[:10])
+
+
+def test_find_synonyms_word_and_vector(fitted):
+    by_word = fitted.findSynonyms("germany", 5)
+    assert len(by_word) == 5
+    assert all(isinstance(w, str) for w, _ in by_word)
+    # vector flavor (the reference accepts either, ml_glintword2vec.py:330)
+    arr = fitted.findSynonymsArray(
+        np.asarray(fitted.getVectors()[0][1]), 3
+    )
+    assert len(arr) == 3
+
+
+def test_get_vectors_and_transform(fitted, tiny_corpus):
+    vecs = fitted.getVectors()
+    assert len(vecs) > 50
+    word, vec = vecs[0]
+    assert isinstance(word, str) and vec.shape == (48,)
+    out = fitted.transform([["germany", "berlin"], ["nonexistent_word"]])
+    assert out.shape == (2, 48)
+    assert np.linalg.norm(out[0]) > 0
+    np.testing.assert_array_equal(out[1], 0)  # all-OOV row -> zeros
+
+
+def test_save_load_roundtrip(fitted, tmp_path):
+    path = str(tmp_path / "compat_model")
+    fitted.write().overwrite().save(path)
+    loaded = ServerSideGlintWord2VecModel.load(path)
+    a = fitted.findSynonyms("germany", 3)
+    b = loaded.findSynonyms("germany", 3)
+    assert [w for w, _ in a] == [w for w, _ in b]
+    with pytest.raises(ValueError, match="parameterServerHost"):
+        ServerSideGlintWord2VecModel.load(path, parameterServerHost="h")
+    loaded.stop(terminateOtherClients=True)
