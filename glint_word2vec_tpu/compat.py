@@ -32,6 +32,8 @@ Documented behavioral divergences (see README "Faithfulness"):
     mode.
   * `stop(terminateOtherClients=True)` is accepted but meaningless: no
     other clients exist.
+
+``seed=None`` draws a fresh random seed per fit, matching the reference.
 """
 
 from __future__ import annotations
@@ -251,7 +253,12 @@ class ServerSideGlintWord2Vec:
             min_count=kw["minCount"],
             num_iterations=kw["maxIter"],
             max_sentence_length=kw["maxSentenceLength"],
-            seed=kw["seed"] if kw["seed"] is not None else 1,
+            # seed=None means a fresh random seed, as in the reference.
+            seed=(
+                kw["seed"]
+                if kw["seed"] is not None
+                else int(np.random.default_rng().integers(2**31 - 1))
+            ),
             num_partitions=num_data,
             num_shards=num_model,
             unigram_table_size=kw["unigramTableSize"],
@@ -337,21 +344,9 @@ class ServerSideGlintWord2VecModel:
                 "parameterServerHost has no analogue; load onto a custom "
                 "topology with Word2VecModel.load(path, mesh=...)"
             )
-        import json
-        import os
-
-        from glint_word2vec_tpu.parallel.mesh import make_mesh
-
-        # Clamp the saved topology to the live device count, exactly as
-        # fit() does — a model trained on a big mesh must load on a small
-        # host (the re-homing capability, ml:584-586).
-        with open(os.path.join(path, "params.json")) as f:
-            saved = json.load(f)
-        num_data, num_model = _mesh_axes(
-            saved.get("num_partitions", 1), saved.get("num_shards", 1)
-        )
-        mesh = make_mesh(num_data, num_model)
-        return cls(Word2VecModel.load(path, mesh=mesh))
+        # Word2VecModel.load clamps the saved topology to the live device
+        # count itself (the re-homing capability, ml:584-586).
+        return cls(Word2VecModel.load(path))
 
     def stop(self, terminateOtherClients: bool = False) -> None:
         """Release the distributed matrices (ml_glintword2vec.py:375-383).

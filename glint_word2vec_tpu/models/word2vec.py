@@ -527,8 +527,12 @@ class Word2VecModel:
     def load(cls, path: str, mesh=None) -> "Word2VecModel":
         """Rebuild from :meth:`save` output onto any mesh — the analogue of
         loading onto a fresh or *different* PS cluster (mllib:696-725;
-        host-override at ml:584-586). Shared by all model families; the
-        family-specific tail lives in :meth:`_from_loaded`."""
+        host-override at ml:584-586). With no explicit mesh, the saved
+        topology is clamped to the live device count, so a model trained
+        on a big mesh loads on a small host. Shared by all model families;
+        the family-specific tail lives in :meth:`_from_loaded`."""
+        import jax
+
         from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
         from glint_word2vec_tpu.parallel.mesh import make_mesh
 
@@ -537,7 +541,10 @@ class Word2VecModel:
         with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
             words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
         if mesh is None:
-            mesh = make_mesh(params.num_partitions, params.num_shards)
+            n_dev = len(jax.devices())
+            num_model = max(1, min(params.num_shards, n_dev))
+            num_data = max(1, min(params.num_partitions, n_dev // num_model))
+            mesh = make_mesh(num_data, num_model)
         engine = EmbeddingEngine.load(os.path.join(path, "matrix"), mesh)
         counts = engine._counts
         if len(words) != engine.vocab_size:

@@ -1,0 +1,112 @@
+"""Pallas TPU kernels for the sparse row traffic of the SGNS step.
+
+The hot sparse ops in the engine (parallel/engine.py) are row gathers
+(``_pull_rows``) and rank-1 row scatter-adds (``_scatter_rows``) into the
+(V, d) embedding tables — the device-side restatement of what the reference
+parameter servers do inside ``dotprod``/``adjust`` (mllib:421-425). XLA
+lowers them to generic gather/scatter; these kernels instead stream one
+table row per grid step with the scalar-prefetch index-map pattern
+(PrefetchScalarGridSpec): the row index arrives before the body runs, so
+Pallas's pipeline overlaps the HBM row DMA for step i+1 with the work of
+step i.
+
+Correctness contract for the scatter: duplicate target rows must SUM their
+updates (synchronous-batch semantics, SURVEY.md §7 hard part 1). Pallas
+only defines output-block revisits when they are CONSECUTIVE grid steps
+(the canonical accumulation pattern — the block stays resident in VMEM
+until the index map moves on); a non-consecutive revisit can read a stale
+copy while the earlier write's DMA is in flight. :func:`scatter_add_rows`
+therefore sorts the updates by row id (duplicates become adjacent) and the
+kernel accumulates into the output block across the run of equal ids:
+first visit writes ``table_row + upd``, later visits add ``upd`` to the
+resident block.
+
+These kernels are OPT-IN (engine flag / GLINT_W2V_PALLAS env var): XLA's
+native lowering is the default until per-hardware measurement says
+otherwise. On CPU they run in interpret mode, which is how the unit tests
+exercise them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gather_kernel(ids_ref, table_block, out_block):
+    del ids_ref  # consumed by the index map
+    out_block[:] = table_block[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gather_rows(table: jax.Array, ids: jax.Array, *, interpret: bool = False):
+    """``table[ids]`` as a Pallas pipeline: one (1, d) row block per grid
+    step, row address from the prefetched ``ids``."""
+    N = ids.shape[0]
+    d = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, d), table.dtype),
+        interpret=interpret,
+    )(ids.astype(jnp.int32), table)
+
+
+def _scatter_kernel(ids_ref, upd_block, table_block, out_block):
+    # out_block aliases table_block's storage (input_output_aliases). The
+    # ids are sorted, so every revisit of an output row is a CONSECUTIVE
+    # grid step and the block stays resident in VMEM: the first step of a
+    # run of equal ids seeds the block from the table row, later steps
+    # accumulate into it.
+    i = pl.program_id(0)
+    prev = ids_ref[jnp.maximum(i - 1, 0)]
+    is_first = jnp.logical_or(i == 0, ids_ref[i] != prev)
+
+    @pl.when(is_first)
+    def _():
+        out_block[:] = table_block[:] + upd_block[:]
+
+    @pl.when(jnp.logical_not(is_first))
+    def _():
+        out_block[:] = out_block[:] + upd_block[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scatter_add_rows(
+    table: jax.Array, ids: jax.Array, upd: jax.Array, *,
+    interpret: bool = False,
+):
+    """``table.at[ids].add(upd)`` with duplicate-summing semantics, as an
+    in-place (aliased) Pallas row pipeline over id-sorted updates."""
+    N, d = upd.shape
+    order = jnp.argsort(ids.astype(jnp.int32))
+    sid = ids.astype(jnp.int32)[order]
+    supd = upd.astype(table.dtype)[order]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, ids: (i, 0)),  # update row
+            pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),  # table row
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={2: 0},  # table arg (after prefetch) -> output
+        interpret=interpret,
+    )(sid, supd, table)

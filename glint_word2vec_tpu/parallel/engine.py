@@ -63,30 +63,45 @@ from glint_word2vec_tpu.parallel.mesh import (
 )
 
 
-def _pull_rows(table_l, idx, start, rows_per_shard):
+def _pull_rows(table_l, idx, start, rows_per_shard, pallas_mode=0):
     """Gather global rows from a shard-local table: contribute owned rows,
     zeros elsewhere, then psum over the model axis. The TPU analogue of the
-    servers each answering a pull with their slice (SURVEY.md §2.2 pull)."""
+    servers each answering a pull with their slice (SURVEY.md §2.2 pull).
+
+    ``pallas_mode``: 0 = XLA gather (default), 1 = Pallas row pipeline
+    (ops/pallas_rows.py), 2 = Pallas in interpret mode (CPU tests).
+    """
     loc = idx - start
     own = (loc >= 0) & (loc < rows_per_shard)
-    rows = jnp.where(
-        own[:, None],
-        table_l[jnp.clip(loc, 0, rows_per_shard - 1)].astype(jnp.float32),
-        0.0,
-    )
+    clipped = jnp.clip(loc, 0, rows_per_shard - 1)
+    if pallas_mode:
+        from glint_word2vec_tpu.ops.pallas_rows import gather_rows
+
+        rows = gather_rows(
+            table_l, clipped, interpret=pallas_mode == 2
+        ).astype(jnp.float32)
+    else:
+        rows = table_l[clipped].astype(jnp.float32)
+    rows = jnp.where(own[:, None], rows, 0.0)
     return lax.psum(rows, MODEL_AXIS)
 
 
-def _scatter_rows(table_l, idx, upd, start, rows_per_shard):
+def _scatter_rows(table_l, idx, upd, start, rows_per_shard, pallas_mode=0):
     """Apply global rank-1 updates to the owned slice of a sharded table
     (the servers' half of ``adjust``, SURVEY.md §2.2). Disowned updates are
-    zeroed and land harmlessly on a clipped row."""
+    zeroed and land harmlessly on a clipped row. ``pallas_mode`` as in
+    :func:`_pull_rows`."""
     loc = idx - start
     own = (loc >= 0) & (loc < rows_per_shard)
     upd = jnp.where(own[:, None], upd, 0.0)
-    return table_l.at[jnp.clip(loc, 0, rows_per_shard - 1)].add(
-        upd.astype(table_l.dtype)
-    )
+    clipped = jnp.clip(loc, 0, rows_per_shard - 1)
+    if pallas_mode:
+        from glint_word2vec_tpu.ops.pallas_rows import scatter_add_rows
+
+        return scatter_add_rows(
+            table_l, clipped, upd, interpret=pallas_mode == 2
+        )
+    return table_l.at[clipped].add(upd.astype(table_l.dtype))
 
 
 class EmbeddingEngine:
@@ -118,6 +133,7 @@ class EmbeddingEngine:
         dtype: str = "float32",
         extra_rows: int = 0,
         shared_negatives: int = 0,
+        use_pallas: Optional[bool] = None,
     ):
         """``extra_rows`` appends non-vocabulary rows to both tables (e.g.
         fastText char-ngram buckets, models/fasttext.py): they are trained
@@ -143,6 +159,14 @@ class EmbeddingEngine:
         self.unigram_power = float(unigram_power)
         self.unigram_table_size = unigram_table_size
         self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        # Pallas row kernels for the sparse table traffic: opt-in per
+        # engine or via GLINT_W2V_PALLAS=1; interpret mode off-TPU so the
+        # same flag is testable on the CPU mesh.
+        if use_pallas is None:
+            use_pallas = os.environ.get("GLINT_W2V_PALLAS", "0") == "1"
+        self._pallas_mode = 0
+        if use_pallas:
+            self._pallas_mode = 1 if jax.default_backend() == "tpu" else 2
         self.num_data = mesh.shape[DATA_AXIS]
         self.num_model = mesh.shape[MODEL_AXIS]
         self.padded_vocab = pad_to_multiple(self.num_rows, self.num_model)
@@ -195,6 +219,7 @@ class EmbeddingEngine:
     def _build_jitted_fns(self) -> None:
         mesh = self.mesh
         Vs = self.rows_per_shard
+        pm = self._pallas_mode
         n = self.num_negatives
         tspec = P(MODEL_AXIS, None)
         rep = P()
@@ -211,11 +236,11 @@ class EmbeddingEngine:
             start = lax.axis_index(MODEL_AXIS) * Vs
             drank = lax.axis_index(DATA_AXIS)
 
-            h_rows = _pull_rows(syn0_l, centers.reshape(-1), start, Vs)
+            h_rows = _pull_rows(syn0_l, centers.reshape(-1), start, Vs, pm)
             h_rows = h_rows.reshape(Bl, S, -1)
             cnt = jnp.maximum(cmask.sum(axis=1, keepdims=True), 1.0)  # (Bl,1)
             h = (h_rows * cmask[..., None]).sum(axis=1) / cnt
-            u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs)
+            u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs, pm)
             u_pos = u_pos.reshape(Bl, C, -1)
 
             if self.shared_negatives:
@@ -227,7 +252,7 @@ class EmbeddingEngine:
                 pool = sample_negatives(
                     key, prob, alias, (self.shared_negatives,)
                 )
-                u_pool = _pull_rows(syn1_l, pool, start, Vs)
+                u_pool = _pull_rows(syn1_l, pool, start, Vs, pm)
                 collide = sgns.pool_collision_mask(pool, contexts, mask)
                 g = sgns.shared_sgns_grads(
                     h, u_pos, u_pool, mask, collide,
@@ -256,7 +281,7 @@ class EmbeddingEngine:
                 negs = lax.dynamic_slice_in_dim(
                     negs_full, drank * Bl, Bl, axis=0
                 )
-                u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs)
+                u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs, pm)
                 u_neg = u_neg.reshape(Bl, C, n, -1)
                 nmask = sgns.negative_mask(negs, contexts, mask)
                 g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
@@ -284,8 +309,8 @@ class EmbeddingEngine:
             upd0_g = lax.all_gather(
                 d_sub.reshape(Bl * S, -1), DATA_AXIS, tiled=True
             )
-            syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs)
-            syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs)
+            syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs, pm)
+            syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs, pm)
 
             # Masked-mean loss over the global batch.
             denom = mask.sum()
@@ -348,7 +373,7 @@ class EmbeddingEngine:
 
         def local_pull(table_l, idx):
             start = lax.axis_index(MODEL_AXIS) * Vs
-            return _pull_rows(table_l, idx, start, Vs)
+            return _pull_rows(table_l, idx, start, Vs, pm)
 
         self._pull = jax.jit(
             self._shard_map(local_pull, in_specs=(tspec, rep), out_specs=rep)
@@ -358,7 +383,7 @@ class EmbeddingEngine:
             # idx/m: (S, L) padded sentence word-indices + validity mask.
             S, L = idx.shape
             start = lax.axis_index(MODEL_AXIS) * Vs
-            rows = _pull_rows(table_l, idx.reshape(-1), start, Vs)
+            rows = _pull_rows(table_l, idx.reshape(-1), start, Vs, pm)
             rows = rows.reshape(S, L, -1) * m[..., None]
             return rows.sum(axis=1) / jnp.maximum(
                 m.sum(axis=1)[:, None], 1.0
