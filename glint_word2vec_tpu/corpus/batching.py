@@ -173,7 +173,7 @@ class SkipGramBatcher:
             raise ValueError("batch_size must be > 0")
         if window <= 0:
             raise ValueError("window must be > 0")
-        self.sentences = sentences
+        self.sentences: Optional[List[np.ndarray]] = sentences
         self.vocab = vocab
         self.batch_size = int(batch_size)
         self.window = int(window)
@@ -181,8 +181,48 @@ class SkipGramBatcher:
         self.shuffle = bool(shuffle)
         self.keep_prob = vocab.keep_probabilities(subsample_ratio)
         self.words_done = 0
-        # Flattened corpus view for the native epoch pass (built lazily).
+        # Flattened corpus view (ids, offsets) for the native epoch pass;
+        # built lazily from `sentences`, or supplied directly by
+        # :meth:`from_flat` (streaming ingestion, corpus/vocab.encode_file).
         self._flat: tuple | None = None
+
+    @classmethod
+    def from_flat(
+        cls,
+        ids: np.ndarray,
+        offsets: np.ndarray,
+        vocab: Vocabulary,
+        *,
+        batch_size: int,
+        window: int,
+        subsample_ratio: float = 0.0,
+        seed: int = 1,
+        shuffle: bool = False,
+    ) -> "SkipGramBatcher":
+        """Build from the flat (ids, offsets) corpus encoding without ever
+        materializing per-sentence Python objects — constant ~4 bytes of
+        host memory per kept word (see corpus/vocab.encode_file)."""
+        b = cls(
+            [], vocab, batch_size=batch_size, window=window,
+            subsample_ratio=subsample_ratio, seed=seed, shuffle=shuffle,
+        )
+        b.sentences = None
+        b._flat = (
+            np.ascontiguousarray(ids, dtype=np.int32),
+            np.ascontiguousarray(offsets, dtype=np.int64),
+        )
+        return b
+
+    def _n_sentences(self) -> int:
+        if self.sentences is not None:
+            return len(self.sentences)
+        return len(self._flat[1]) - 1
+
+    def _sentence(self, i: int) -> np.ndarray:
+        if self.sentences is not None:
+            return self.sentences[i]
+        ids, offsets = self._flat
+        return ids[offsets[i] : offsets[i + 1]]
 
     def epoch(self, epoch_index: int) -> Iterator[Batch]:
         """Yield every minibatch of one pass over the corpus.

@@ -138,3 +138,48 @@ def iter_text_file(path: str, lowercase: bool = False) -> Iterator[List[str]]:
             toks = line.lower().split() if lowercase else line.split()
             if toks:
                 yield toks
+
+
+def encode_file(
+    path: str,
+    vocab: Vocabulary,
+    max_sentence_length: int = 1000,
+    lowercase: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Streaming-encode a text file into the flat corpus representation:
+    ``(ids int32[total], offsets int64[n_sentences+1])``, OOV dropped,
+    sentences chunked at ``max_sentence_length`` (mllib:336,341 semantics).
+
+    Host memory is ~4 bytes per kept word regardless of corpus size — the
+    constant-factor fix for the reference's RDD-free analogue (a Python
+    sentence list costs ~15x more). Pairs with
+    ``SkipGramBatcher.from_flat``.
+    """
+    if max_sentence_length <= 0:
+        raise ValueError("max_sentence_length must be > 0")
+    wi = vocab.word_index
+    id_blocks: List[np.ndarray] = []
+    lengths: List[int] = []
+    buf: List[int] = []
+    BLOCK = 1 << 20
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            toks = line.lower().split() if lowercase else line.split()
+            ids = [wi[t] for t in toks if t in wi]
+            if not ids:
+                continue
+            for s in range(0, len(ids), max_sentence_length):
+                chunk = ids[s : s + max_sentence_length]
+                lengths.append(len(chunk))
+                buf.extend(chunk)
+            if len(buf) >= BLOCK:
+                id_blocks.append(np.asarray(buf, dtype=np.int32))
+                buf = []
+    if buf:
+        id_blocks.append(np.asarray(buf, dtype=np.int32))
+    flat = (
+        np.concatenate(id_blocks) if id_blocks else np.zeros(0, np.int32)
+    )
+    offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
+    np.cumsum(np.asarray(lengths, dtype=np.int64), out=offsets[1:])
+    return flat, offsets
