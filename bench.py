@@ -1,100 +1,289 @@
 """Benchmark: sustained SGNS training throughput on the available device.
 
-Measures the fused train step (the dotprod+adjust equivalent) in steady
-state on a realistic large-vocab configuration, reporting trained words per
-second per chip. Baseline: the driver north-star of 50M words/sec on a
-v5e-32 (BASELINE.json) = 1.5625M words/sec/chip; the reference itself
-publishes no throughput numbers (BASELINE.md).
+Measures the fused train step (the dotprod+adjust equivalent of the
+reference's hot loop, mllib/feature/ServerSideGlintWord2Vec.scala:421-425)
+in steady state on a realistic large-vocab configuration, reporting trained
+words per second per chip plus an MFU estimate. Baseline: the driver
+north-star of 50M words/sec on a v5e-32 (BASELINE.json) = 1.5625M
+words/sec/chip; the reference itself publishes no throughput numbers
+(BASELINE.md).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "words/sec/chip", "vs_baseline": N}
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "words/sec/chip", "vs_baseline": N, ...}
 
-Environment knobs (for smoke-testing on CPU):
-  BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_STEPS, BENCH_PLATFORM,
-  BENCH_SPC (minibatches per device dispatch — the scan length),
-  BENCH_SHARED_NEG (shared noise-pool size; 0 = per-pair draws)
+The headline "value" is the PER-PAIR estimator (reference semantics: n fresh
+negatives per (center, context) pair) so vs_baseline is comparable to the
+reference's algorithm; the shared-negative-pool mode (the TPU-shaped
+estimator) is reported alongside under "modes". The full config is echoed in
+the line so no number is ever ambiguous about what it measured.
+
+Robustness: the actual measurement runs in a worker subprocess. If TPU
+backend init fails or hangs (the tunnel is flaky: round 1 died with
+UNAVAILABLE at import), the orchestrator retries once, then falls back to
+CPU with the platform recorded, then — only if even that fails — emits a
+diagnostic JSON line. It always exits 0 with one JSON line on stdout.
+
+Environment knobs:
+  BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_SPC (minibatches per device
+  dispatch = scan length), BENCH_SHARED_NEG (pool size for the shared mode),
+  BENCH_MODES ("per_pair,shared" default), BENCH_DTYPE (float32|bfloat16),
+  BENCH_PLATFORM (force a JAX platform), BENCH_ATTEMPT_TIMEOUT (seconds per
+  worker attempt, default 600; the retry attempt is capped at 300),
+  BENCH_MIN_SECONDS (timed-loop floor).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 BASELINE_WORDS_PER_SEC_PER_CHIP = 50e6 / 32
 
+# Peak dense-matmul throughput by device kind, used only for the MFU
+# *estimate*. Values are the published bf16 peaks; float32 tables still do
+# their dot products through the MXU (via bf16x3-ish passes), so the MFU for
+# float32 runs is an underestimate against this peak — recorded as such.
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 394e12),  # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def main() -> None:
-    if os.environ.get("BENCH_PLATFORM"):
-        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
-    import jax
 
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+def _peak_for(device_kind: str):
+    dk = device_kind.lower()
+    for tag, peak in _PEAK_FLOPS:
+        if tag in dk:
+            return peak
+    return None
 
+
+def _config_from_env():
+    return {
+        "vocab": int(os.environ.get("BENCH_VOCAB", 1_000_000)),
+        "dim": int(os.environ.get("BENCH_DIM", 300)),
+        "batch": int(os.environ.get("BENCH_BATCH", 8192)),
+        "steps_per_call": int(os.environ.get("BENCH_SPC", 32)),
+        "shared_negatives": int(os.environ.get("BENCH_SHARED_NEG", 4096)),
+        "negatives": 5,
+        "context_lanes": 7,
+        "dtype": os.environ.get("BENCH_DTYPE", "float32"),
+        "modes": os.environ.get("BENCH_MODES", "per_pair,shared"),
+    }
+
+
+def _flops_per_step(mode: str, cfg) -> float:
+    """Analytic FLOPs of one minibatch update (matmul-equivalent count).
+
+    Per-pair (ops/sgns.py sgns_grads + rank-1 expansion): f_pos 2BCd,
+    f_neg 2BCnd, d_center 2BCd+2BCnd, outer products BCd+BCnd, scatter adds
+    BCd+BCnd+Bd  => ~6BCd(1+n) + Bd.
+    Shared pool (shared_sgns_grads): f_pos 2BCd, f_pool 2BSd, d_center
+    2BCd+2BSd, d_pool 2BSd, outer+scatter 2BCd+Bd+Sd => ~6BCd + 6BSd.
+    """
+    B, C, d, n = cfg["batch"], cfg["context_lanes"], cfg["dim"], cfg["negatives"]
+    if mode == "per_pair":
+        return 6.0 * B * C * d * (1 + n) + B * d
+    S = cfg["shared_negatives"]
+    return 6.0 * B * C * d + 6.0 * B * S * d + B * d + S * d
+
+
+# ----------------------------------------------------------------------
+# Worker: does the measurement, prints the JSON line.
+# ----------------------------------------------------------------------
+
+
+def _bench_mode(jax, mesh, cfg, mode: str, np):
     from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
-    from glint_word2vec_tpu.parallel.mesh import make_mesh
 
-    V = int(os.environ.get("BENCH_VOCAB", 1_000_000))
-    d = int(os.environ.get("BENCH_DIM", 300))
-    B = int(os.environ.get("BENCH_BATCH", 8192))
-    steps = int(os.environ.get("BENCH_STEPS", 64))
-    spc = int(os.environ.get("BENCH_SPC", 32))  # minibatches per dispatch
-    # Shared noise-pool size (the TPU-shaped estimator; see
-    # Word2VecParams.shared_negatives). 0 benches per-pair draws.
-    shared = int(os.environ.get("BENCH_SHARED_NEG", 4096))
-    C, n = 7, 5  # window=5 context lanes, 5 negatives (reference defaults)
-    steps = (steps // spc) * spc or spc
+    V, d, B = cfg["vocab"], cfg["dim"], cfg["batch"]
+    spc, C, n = cfg["steps_per_call"], cfg["context_lanes"], cfg["negatives"]
+    shared = cfg["shared_negatives"] if mode == "shared" else 0
 
     # Zipf-ish counts: realistic index skew for gathers and the noise table.
     ranks = np.arange(1, V + 1, dtype=np.float64)
     counts = np.maximum((1e9 / ranks), 1.0).astype(np.int64)
 
-    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
     eng = EmbeddingEngine(
         mesh, V, d, counts, num_negatives=n, seed=0,
-        shared_negatives=shared,
+        shared_negatives=shared, dtype=cfg["dtype"],
     )
 
     rng = np.random.default_rng(0)
     # Zipf-distributed center/context draws (the hot rows dominate, as in
     # real corpora after subsampling). One stacked group of spc minibatches,
-    # dispatched as a single on-device lax.scan (engine.train_steps) — the
-    # production hot path of fit().
+    # dispatched as a single on-device lax.scan — the production hot path.
     p = (counts / counts.sum()).astype(np.float64)
     centers_k = rng.choice(V, size=(spc, B), p=p).astype(np.int32)
     contexts_k = rng.choice(V, size=(spc, B, C), p=p).astype(np.int32)
     mask_k = (rng.random((spc, B, C)) < 0.85).astype(np.float32)
     alphas = np.full(spc, 0.025, np.float32)
-
     key = jax.random.PRNGKey(0)
+
     # Warm up / compile.
+    t0 = time.time()
     losses = eng.train_steps(centers_k, contexts_k, mask_k, key, alphas, 0)
     jax.block_until_ready(losses)
+    compile_s = time.time() - t0
 
+    # Timed loop: run dispatches until the floor is reached so one number
+    # is never a single-dispatch fluke.
+    min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", 2.0))
+    max_calls = int(os.environ.get("BENCH_MAX_CALLS", 50))
     t0 = time.time()
+    calls = 0
     last = None
-    for g in range(steps // spc):
+    while calls < max_calls:
         last = eng.train_steps(
-            centers_k, contexts_k, mask_k, key, alphas, g * spc
+            centers_k, contexts_k, mask_k, key, alphas, calls * spc
         )
+        calls += 1
+        if calls >= 2 and time.time() - t0 >= min_seconds:
+            break
     jax.block_until_ready(last)
     dt = time.time() - t0
 
+    steps = calls * spc
     words = B * steps  # trained center positions == reference word count
     wps = words / dt
+    flops = _flops_per_step(mode, cfg) * steps / dt
+    del eng  # release the two V x d tables before the next mode runs
+    return {
+        "words_per_sec": round(wps, 1),
+        "step_time_us": round(dt / steps * 1e6, 1),
+        "compile_s": round(compile_s, 1),
+        "flops_per_sec": round(flops, 3),
+        "timed_steps": steps,
+    }
+
+
+def worker_main() -> None:
+    if os.environ.get("BENCH_PLATFORM"):
+        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    import numpy as np
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        # The env var alone is not enough under environments that
+        # pre-register a remote TPU backend and pin jax_platforms at
+        # interpreter start; the config update must win.
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    cfg = _config_from_env()
+    dev = jax.devices()[0]
+    mesh = make_mesh(1, 1, devices=[dev])
+    peak = _peak_for(dev.device_kind) if dev.platform == "tpu" else None
+
+    modes = [m.strip() for m in cfg.pop("modes").split(",") if m.strip()]
+    results = {}
+    for mode in modes:
+        r = _bench_mode(jax, mesh, cfg, mode, np)
+        if peak:
+            r["mfu"] = round(r.pop("flops_per_sec") / peak, 4)
+        else:
+            r.pop("flops_per_sec")
+        results[mode] = r
+
+    headline = results.get("per_pair") or next(iter(results.values()))
+    wps = headline["words_per_sec"]
+    line = {
+        "metric": "sgns_train_throughput",
+        "value": wps,
+        "unit": "words/sec/chip",
+        "vs_baseline": round(wps / BASELINE_WORDS_PER_SEC_PER_CHIP, 4),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "estimator": "per_pair" if "per_pair" in results else modes[0],
+        "config": cfg,
+        "modes": results,
+    }
+    if peak:
+        line["peak_flops_assumed"] = peak
+        if "mfu" in headline:
+            line["mfu"] = headline["mfu"]
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+# ----------------------------------------------------------------------
+# Orchestrator: subprocess + retry + CPU fallback + diagnostic line.
+# ----------------------------------------------------------------------
+
+
+def _run_worker(env, timeout):
+    """Run one worker attempt; return (json_line_or_None, error_string)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"worker timed out after {timeout}s"
+    for ln in reversed(proc.stdout.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and '"metric"' in ln:
+            try:
+                json.loads(ln)
+                return ln, ""
+            except json.JSONDecodeError:
+                pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
+
+
+def main() -> None:
+    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 600))
+    base_env = dict(os.environ, BENCH_WORKER="1")
+
+    attempts = []
+    plans = [
+        ("default", base_env, timeout),
+        ("retry", base_env, min(timeout, 300.0)),
+    ]
+    if os.environ.get("BENCH_PLATFORM", "") != "cpu":
+        cpu_env = dict(base_env, BENCH_PLATFORM="cpu")
+        # CPU can't hold/update two 1Mx300 tables fast enough to be a
+        # meaningful number; shrink unless the caller pinned the shape.
+        if "BENCH_VOCAB" not in os.environ:
+            cpu_env["BENCH_VOCAB"] = "100000"
+        if "BENCH_BATCH" not in os.environ:
+            cpu_env["BENCH_BATCH"] = "1024"
+        plans.append(("cpu-fallback", cpu_env, timeout))
+
+    for name, env, t in plans:
+        line, err = _run_worker(env, t)
+        if line is not None:
+            obj = json.loads(line)
+            if name == "cpu-fallback":
+                obj["fallback"] = "cpu"
+            if len(attempts):
+                obj["failed_attempts"] = attempts
+            print(json.dumps(obj))
+            return
+        attempts.append({"attempt": name, "error": err[:500]})
+
     print(
         json.dumps(
             {
                 "metric": "sgns_train_throughput",
-                "value": round(wps, 1),
+                "value": 0.0,
                 "unit": "words/sec/chip",
-                "vs_baseline": round(wps / BASELINE_WORDS_PER_SEC_PER_CHIP, 4),
+                "vs_baseline": 0.0,
+                "error": "all backend attempts failed",
+                "failed_attempts": attempts,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_WORKER") == "1":
+        worker_main()
+    else:
+        main()

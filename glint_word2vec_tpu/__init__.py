@@ -36,6 +36,7 @@ __all__ = [
     "FastTextWord2Vec",
     "FastTextModel",
     "FastTextParams",
+    "load_model",
     "ServerSideGlintWord2Vec",
     "ServerSideGlintWord2VecModel",
 ]
@@ -51,6 +52,10 @@ def __getattr__(name):
         from glint_word2vec_tpu.models import fasttext
 
         return getattr(fasttext, name)
+    if name == "load_model":
+        from glint_word2vec_tpu.models import load_model
+
+        return load_model
     if name == "Word2VecParams":
         from glint_word2vec_tpu.utils.params import Word2VecParams
 

@@ -50,6 +50,16 @@ def _add_train(sub):
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--metrics-out", default=None,
                    help="write training metrics JSON here")
+    p.add_argument("--fasttext", action="store_true",
+                   help="train the subword (fastText-style) family")
+    p.add_argument("--min-n", type=int, default=3,
+                   help="min char-ngram length (fastText family)")
+    p.add_argument("--max-n", type=int, default=6,
+                   help="max char-ngram length (fastText family)")
+    p.add_argument("--bucket", type=int, default=2_000_000,
+                   help="subword hash-bucket rows (fastText family)")
+    p.add_argument("--max-subwords", type=int, default=32,
+                   help="max subword rows per word (fastText family)")
 
 
 def _add_query(sub):
@@ -102,12 +112,10 @@ def main(argv=None) -> int:
 
 def _run(args) -> int:
 
-    from glint_word2vec_tpu import Word2Vec, Word2VecModel
-    from glint_word2vec_tpu.corpus.vocab import iter_text_file
+    from glint_word2vec_tpu import FastTextWord2Vec, Word2Vec, load_model
 
     if args.cmd == "train":
-        sentences = list(iter_text_file(args.corpus, lowercase=args.lowercase))
-        w2v = Word2Vec(
+        kw = dict(
             vector_size=args.vector_size,
             window=args.window,
             step_size=args.step_size,
@@ -124,7 +132,19 @@ def _run(args) -> int:
             steps_per_call=args.steps_per_call,
             shared_negatives=args.shared_negatives,
         )
-        model = w2v.fit(sentences, checkpoint_dir=args.checkpoint_dir)
+        if args.fasttext:
+            w2v = FastTextWord2Vec(
+                **kw, min_n=args.min_n, max_n=args.max_n,
+                bucket=args.bucket, max_subwords=args.max_subwords,
+            )
+        else:
+            w2v = Word2Vec(**kw)
+        # Streaming ingestion (fit_file): two passes over the file, flat
+        # int32 encoding — never materializes Python sentence lists.
+        model = w2v.fit_file(
+            args.corpus, lowercase=args.lowercase,
+            checkpoint_dir=args.checkpoint_dir,
+        )
         model.save(args.output)
         print(json.dumps({"saved": args.output, **(model.training_metrics or {})}))
         if args.metrics_out:
@@ -132,7 +152,7 @@ def _run(args) -> int:
                 json.dump(model.training_metrics, f)
         return 0
 
-    model = Word2VecModel.load(args.model)
+    model = load_model(args.model)
     if args.cmd == "synonyms":
         for w, s in model.find_synonyms(args.word, args.num):
             print(f"{w}\t{s:.4f}")
@@ -154,6 +174,7 @@ def _run(args) -> int:
         print(
             json.dumps(
                 {
+                    "family": type(model).__name__,
                     "vocab_size": model.vocab.size,
                     "vector_size": model.vector_size,
                     "train_words_count": model.vocab.train_words_count,

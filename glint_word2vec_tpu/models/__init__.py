@@ -1,1 +1,32 @@
 """Model layer: the Word2Vec estimator and fitted Word2VecModel."""
+
+import json
+import os
+
+
+def load_model(path: str, mesh=None):
+    """Load a saved model of ANY family, dispatching on its params.json.
+
+    The analogue of the reference's single load entry point
+    (``ServerSideGlintWord2VecModel.load``, mllib:671-726): the caller names
+    a directory; the family is recovered from the persisted metadata.
+    FastText metadata carries the subword-geometry keys (``bucket`` et al.,
+    models/fasttext.py FastTextParams); plain word2vec metadata does not.
+    """
+    params_path = os.path.join(path, "params.json")
+    try:
+        with open(params_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise FileNotFoundError(f"no model at {path!r} (missing params.json)")
+    except OSError as e:
+        raise ValueError(f"cannot read model metadata at {params_path}: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"corrupt model metadata at {params_path}: {e}")
+    if "bucket" in meta:
+        from glint_word2vec_tpu.models.fasttext import FastTextModel
+
+        return FastTextModel.load(path, mesh=mesh)
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+    return Word2VecModel.load(path, mesh=mesh)

@@ -586,7 +586,15 @@ class Word2VecModel:
         from glint_word2vec_tpu.parallel.mesh import make_mesh
 
         with open(os.path.join(path, "params.json")) as f:
-            params = cls._PARAMS_CLS.from_json(f.read())
+            try:
+                params = cls._PARAMS_CLS.from_json(f.read())
+            except TypeError as e:
+                # e.g. a params.json from a different model family fed to
+                # the wrong loader (use models.load_model to dispatch).
+                raise ValueError(
+                    f"params.json at {path} does not describe a "
+                    f"{cls._PARAMS_CLS.__name__} model: {e}"
+                )
         with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
             words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
         if mesh is None:
