@@ -243,11 +243,24 @@ class ServerSideGlintWord2Vec:
         num_data, num_model = _mesh_axes(
             kw["numPartitions"], kw["numParameterServers"]
         )
+        # The reference's batchSize is per-worker and unconstrained by
+        # numPartitions (e.g. the defaults batchSize=50, numPartitions=4);
+        # here the global batch must divide the data axis. Round up instead
+        # of failing a valid reference configuration.
+        batch_size = kw["batchSize"]
+        if batch_size % num_data:
+            rounded = -(-batch_size // num_data) * num_data
+            warnings.warn(
+                f"batchSize={batch_size} is not divisible by the data-axis "
+                f"size {num_data}; rounding up to {rounded}",
+                stacklevel=2,
+            )
+            batch_size = rounded
         params = Word2VecParams(
             vector_size=kw["vectorSize"],
             window=kw["windowSize"],
             step_size=kw["stepSize"],
-            batch_size=kw["batchSize"],
+            batch_size=batch_size,
             num_negatives=kw["n"],
             subsample_ratio=kw["subsampleRatio"],
             min_count=kw["minCount"],

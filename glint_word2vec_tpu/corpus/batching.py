@@ -300,7 +300,15 @@ class SkipGramBatcher:
                 seed,
             )
             centers, contexts, mask, words_done = out
-            self.words_done += int(words_done)
+            # Attribute the block's word count to its batches *pro rata* by
+            # center positions consumed, so the LR anneal sees a smooth
+            # words_done ramp. Bumping the counter once per block would hand
+            # every batch the block-end count — at block size >= corpus size
+            # that collapses the whole linear schedule to one alpha per
+            # epoch (and the floor for the final epoch).
+            wd_base = self.words_done
+            block_words = int(words_done)
+            self.words_done += block_words
             n = centers.shape[0]
             start = 0
             while n - start > 0:
@@ -311,9 +319,8 @@ class SkipGramBatcher:
                 fill += take
                 start += take
                 if fill == B:
-                    yield Batch(
-                        buf_c.copy(), buf_x.copy(), buf_m.copy(), self.words_done
-                    )
+                    wd = wd_base + int(round(block_words * (start / n)))
+                    yield Batch(buf_c.copy(), buf_x.copy(), buf_m.copy(), wd)
                     fill = 0
             s = e
             block += 1
@@ -326,7 +333,7 @@ class SkipGramBatcher:
     def _epoch_python(self, epoch_index: int) -> Iterator[Batch]:
         B, W2 = self.batch_size, context_width(self.window)
         rng = np.random.default_rng((self.seed, epoch_index))
-        order = np.arange(len(self.sentences))
+        order = np.arange(self._n_sentences())
         if self.shuffle:
             rng.shuffle(order)
 
@@ -335,8 +342,9 @@ class SkipGramBatcher:
         buf_m = np.zeros((B, W2), dtype=np.float32)
         fill = 0
         for si in order:
-            self.words_done += int(self.sentences[si].size)
-            ids = subsample_sentence(self.sentences[si], self.keep_prob, rng)
+            sent = self._sentence(si)
+            self.words_done += int(sent.size)
+            ids = subsample_sentence(sent, self.keep_prob, rng)
             c, x, m = window_batch(ids, self.window, rng)
             n = c.shape[0]
             start = 0

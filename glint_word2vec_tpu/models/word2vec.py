@@ -163,17 +163,23 @@ class Word2Vec:
         encoded = chunk_sentences(
             encode_sentences(sentences, vocab), p.max_sentence_length
         )
+        lens = np.array([s.size for s in encoded], dtype=np.int64)
+        pc, local_batch, steps_per_epoch = self._multihost_plan(lens)
+        if pc > 1:
+            from glint_word2vec_tpu.parallel import distributed as dist
+
+            encoded = dist.shard_sentences_for_process(encoded)
         batcher = SkipGramBatcher(
             encoded,
             vocab,
-            batch_size=p.batch_size,
+            batch_size=local_batch,
             window=p.window,
             subsample_ratio=p.subsample_ratio,
             seed=p.seed,
         )
         return self._fit_with_batcher(
             vocab, batcher, checkpoint_dir, checkpoint_every_epochs,
-            stop_after_epochs,
+            stop_after_epochs, steps_per_epoch=steps_per_epoch,
         )
 
     def fit_file(
@@ -201,17 +207,74 @@ class Word2Vec:
             path, vocab, max_sentence_length=p.max_sentence_length,
             lowercase=lowercase,
         )
+        pc, local_batch, steps_per_epoch = self._multihost_plan(np.diff(offsets))
+        if pc > 1:
+            from glint_word2vec_tpu.parallel import distributed as dist
+
+            ids, offsets = dist.shard_flat_for_process(ids, offsets)
         batcher = SkipGramBatcher.from_flat(
             ids, offsets, vocab,
-            batch_size=p.batch_size,
+            batch_size=local_batch,
             window=p.window,
             subsample_ratio=p.subsample_ratio,
             seed=p.seed,
         )
         return self._fit_with_batcher(
             vocab, batcher, checkpoint_dir, checkpoint_every_epochs,
-            stop_after_epochs,
+            stop_after_epochs, steps_per_epoch=steps_per_epoch,
         )
+
+    # -- multi-host helpers (SURVEY.md §2.3 DP row; VERDICT.md missing #1) --
+
+    def _multihost_plan(self, sentence_lengths: np.ndarray):
+        """(process_count, local_batch_size, steps_per_epoch) for this run.
+
+        Multi-host contract (shared by fit and fit_file): every process
+        reads the same corpus (the shared-filesystem contract, like the
+        reference's HDFS corpus), builds the identical global vocab with
+        zero communication, and materializes only its round-robin shard
+        (Client.runWithWord2VecMatrixOnSpark's partition placement,
+        mllib:345,354-362). The per-epoch step count is fixed up front from
+        the max shard word count so every process dispatches in lockstep
+        (SPMD collectives deadlock otherwise). Single process returns
+        (1, batch_size, None).
+        """
+        import jax
+
+        pc = jax.process_count()
+        if pc <= 1:
+            return 1, self.params.batch_size, None
+        local_batch = self._local_batch_size(pc)
+        return pc, local_batch, self._steps_per_epoch(
+            sentence_lengths, pc, local_batch
+        )
+
+    def _local_batch_size(self, pc: int) -> int:
+        """Per-process rows of the global batch (each host feeds only the
+        data-axis rows its own devices hold)."""
+        p = self.params
+        if p.batch_size % pc:
+            raise ValueError(
+                f"batch_size ({p.batch_size}) must be divisible by the "
+                f"process count ({pc}) for multi-host training"
+            )
+        return p.batch_size // pc
+
+    @staticmethod
+    def _steps_per_epoch(
+        sentence_lengths: np.ndarray, pc: int, local_batch: int
+    ) -> int:
+        """Agreed per-epoch step count: enough for the wordiest shard.
+
+        Computable identically on every host with no communication (see
+        distributed.per_process_word_counts). Subsampling only *removes*
+        center positions, so this is always an upper bound; short hosts pad
+        zero-mask batches up to it.
+        """
+        from glint_word2vec_tpu.parallel import distributed as dist
+
+        counts = dist.per_process_word_counts(sentence_lengths, pc)
+        return max(1, int(-(-int(counts.max()) // local_batch)))
 
     def _fit_with_batcher(
         self,
@@ -220,10 +283,15 @@ class Word2Vec:
         checkpoint_dir: Optional[str],
         checkpoint_every_epochs: int,
         stop_after_epochs: Optional[int],
+        steps_per_epoch: Optional[int] = None,
     ) -> "Word2VecModel":
+        """Shared training loop. ``steps_per_epoch`` (multi-host only) fixes
+        the number of steps every process dispatches per epoch; None (single
+        process) runs the batcher to exhaustion."""
         import jax
 
         p = self.params
+        pc = jax.process_count()
         logger.info(
             "vocab: %d words, %d train words", vocab.size, vocab.train_words_count
         )
@@ -232,6 +300,12 @@ class Word2Vec:
             raise ValueError(
                 f"batch_size ({p.batch_size}) must be divisible by the "
                 f"data-axis size ({mesh.shape['data']})"
+            )
+        if pc > 1 and mesh.shape["data"] % pc:
+            raise ValueError(
+                f"data-axis size ({mesh.shape['data']}) must be a multiple "
+                f"of the process count ({pc}) so each host's devices form "
+                "whole data rows (set num_partitions accordingly)"
             )
         engine = self._make_engine(mesh, vocab)
         # LR schedule denominator: iterations * total train words + 1
@@ -273,36 +347,105 @@ class Word2Vec:
             # first; state.json (atomic rename) flips to it last, so a crash
             # mid-write can never yield a state file pointing at mismatched
             # or partial tables. Older snapshot dirs are pruned after.
+            # Multi-host: every process writes its own table shards
+            # (engine.save), then a barrier ensures all shards are on disk
+            # before process 0 alone flips state.json and prunes — per-host
+            # counters can diverge only by padding, and a lone writer keeps
+            # the flip atomic.
             ck_name = f"ckpt-{epochs_completed}"
             engine.save(os.path.join(checkpoint_dir, ck_name))
-            tmp = state_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(
-                    {
-                        "epochs_completed": epochs_completed,
-                        "step": step,
-                        "words_done": batcher.words_done,
-                        "ckpt": ck_name,
-                    },
-                    f,
-                )
-            os.replace(tmp, state_path)
-            import shutil
+            if pc > 1:
+                from jax.experimental import multihost_utils
 
-            for entry in os.listdir(checkpoint_dir):
-                if entry.startswith("ckpt-") and entry != ck_name:
-                    shutil.rmtree(
-                        os.path.join(checkpoint_dir, entry), ignore_errors=True
+                multihost_utils.sync_global_devices(
+                    f"glint_w2v_ckpt_{epochs_completed}"
+                )
+            if jax.process_index() == 0:
+                # words_done feeds the resumed run's metrics base and the
+                # single-host LR accounting; under the multi-host schedule
+                # the global pro-rata count is the coherent value (the local
+                # batcher count is per-shard and would mix units).
+                wd = (
+                    batcher.words_done
+                    if steps_per_epoch is None
+                    else epochs_completed * vocab.train_words_count
+                )
+                tmp = state_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {
+                            "epochs_completed": epochs_completed,
+                            "step": step,
+                            "words_done": wd,
+                            "ckpt": ck_name,
+                        },
+                        f,
                     )
+                os.replace(tmp, state_path)
+                import shutil
+
+                for entry in os.listdir(checkpoint_dir):
+                    if entry.startswith("ckpt-") and entry != ck_name:
+                        shutil.rmtree(
+                            os.path.join(checkpoint_dir, entry),
+                            ignore_errors=True,
+                        )
+            if pc > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(
+                    f"glint_w2v_ckpt_done_{epochs_completed}"
+                )
 
         spc = p.steps_per_call
+        twc = vocab.train_words_count
+        # Multi-host: steps_per_epoch fixes the dispatch count; groups are
+        # the scan-length quantized version of it.
+        forced_groups = (
+            None if steps_per_epoch is None
+            else max(1, -(-steps_per_epoch // spc))
+        )
+
+        def _zero_batch() -> Batch:
+            from glint_word2vec_tpu.corpus.batching import context_width
+
+            B, C = batcher.batch_size, context_width(batcher.window)
+            return Batch(
+                centers=np.zeros(B, np.int32),
+                contexts=np.zeros((B, C), np.int32),
+                mask=np.zeros((B, C), np.float32),
+                words_done=batcher.words_done,
+            )
+
+        def _sched_alpha(idx_in_epoch: int, epoch: int) -> tuple:
+            # Deterministic global LR schedule for multi-host lockstep:
+            # every process must compute the identical alpha without
+            # exchanging its (slightly different) local word counts. The
+            # epoch's words are attributed pro-rata over its agreed step
+            # count — the same linear anneal as the reference's global
+            # wordCount-driven schedule (mllib:405-413), quantized to steps.
+            frac = min((idx_in_epoch + 1) / steps_per_epoch, 1.0)
+            wd = epoch * twc + frac * twc
+            return (
+                max(p.step_size * (1 - wd / total_words), p.step_size * 1e-4),
+                int(wd),
+            )
+
         for epoch in range(start_epoch, p.num_iterations):
             # Double-buffered infeed: batches are produced on a background
             # thread while the device executes (utils/prefetch.py), then
             # dispatched ``steps_per_call`` at a time as one on-device scan
             # (EmbeddingEngine.train_steps) — one host round-trip per group.
             it = prefetch(batcher.epoch(epoch), depth=2 * spc)
+            g = 0
             while True:
+                if forced_groups is not None and g >= forced_groups:
+                    if next(it, None) is not None:
+                        raise RuntimeError(
+                            "internal error: local shard produced more "
+                            "batches than the agreed per-epoch step count"
+                        )
+                    break
                 group = []
                 with metrics.timing("host"):
                     while len(group) < spc:
@@ -310,9 +453,18 @@ class Word2Vec:
                         if batch is None:
                             break
                         group.append(batch)
+                pad_only = False
                 if not group:
-                    break
-                n_real = len(group)
+                    if forced_groups is None:
+                        break
+                    # Lockstep padding: this host's shard is exhausted but
+                    # other hosts still have batches — keep dispatching
+                    # zero-mask groups up to the agreed count. These are
+                    # no-op steps: excluded from metrics (n_real=0) so they
+                    # don't deflate loss curves or inflate step counts.
+                    group = [_zero_batch()]
+                    pad_only = True
+                n_real = 0 if pad_only else len(group)
                 if n_real < spc:
                     # Pad the epoch-tail group to the full scan length so
                     # the jitted scan never sees a second K (XLA compiles
@@ -325,13 +477,21 @@ class Word2Vec:
                         words_done=group[-1].words_done,
                     )
                     group.extend([pad] * (spc - n_real))
-                alphas = [
-                    max(
-                        p.step_size * (1 - b.words_done / total_words),
-                        p.step_size * 1e-4,
-                    )
-                    for b in group
-                ]
+                if steps_per_epoch is None:
+                    alphas = [
+                        max(
+                            p.step_size * (1 - b.words_done / total_words),
+                            p.step_size * 1e-4,
+                        )
+                        for b in group
+                    ]
+                    wds = [b.words_done for b in group]
+                else:
+                    sched = [
+                        _sched_alpha(g * spc + j, epoch) for j in range(spc)
+                    ]
+                    alphas = [a for a, _ in sched]
+                    wds = [w for _, w in sched]
                 with metrics.timing("step"):
                     losses = self._train_batches(
                         engine, group, base_key, step, np.asarray(alphas, np.float32)
@@ -339,9 +499,10 @@ class Word2Vec:
                 for i in range(n_real):
                     step += 1
                     metrics.record_step(
-                        group[i].words_done, loss=losses[i], alpha=alphas[i]
+                        wds[i], loss=losses[i], alpha=alphas[i]
                     )
                 step += len(group) - n_real  # padded steps consumed keys too
+                g += 1
             stopping = (
                 stop_after_epochs is not None
                 and (epoch + 1 - start_epoch) >= stop_after_epochs

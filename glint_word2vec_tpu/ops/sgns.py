@@ -181,11 +181,22 @@ def pool_collision_mask(
 ) -> jax.Array:
     """(B, S) mask, 1.0 where a pool word equals one of that row's real
     context words — the pool-wide generalization of the per-draw
-    ``target == word`` skip (see :func:`negative_mask`)."""
-    hits = (pool[None, None, :] == contexts[..., None]) & (
-        mask[..., None] > 0
-    )  # (B, C, S)
-    return hits.any(axis=1).astype(jnp.float32)
+    ``target == word`` skip (see :func:`negative_mask`).
+
+    Membership is tested by sorting each row's C context words and binary-
+    searching the pool into them, so the peak intermediate is O(B*S) — the
+    same order as the (B, S) result — rather than the O(B*C*S) boolean of
+    the naive broadcast compare (~235 MB of transient at the bench shape
+    B=8192, C=7, S=4096, which could dominate step memory)."""
+    sentinel = jnp.iinfo(jnp.int32).max
+    ctx = jnp.where(mask > 0, contexts, sentinel)  # padded lanes never match
+    ctx_sorted = jnp.sort(ctx, axis=1)  # (B, C) — C is tiny
+    idx = jax.vmap(
+        lambda row: jnp.searchsorted(row, pool, side="left")
+    )(ctx_sorted)  # (B, S)
+    idx = jnp.clip(idx, 0, ctx_sorted.shape[1] - 1)
+    found = jnp.take_along_axis(ctx_sorted, idx, axis=1) == pool[None, :]
+    return found.astype(jnp.float32)
 
 
 def train_step(

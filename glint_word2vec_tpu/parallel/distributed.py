@@ -58,8 +58,7 @@ def initialize(
     """
     import jax
 
-    state = getattr(jax._src.distributed, "global_state", None)
-    if state is not None and state.client is not None:  # already up
+    if _is_initialized(jax):  # already up
         logger.info("jax.distributed already initialized; skipping")
         return
     kwargs = {}
@@ -79,6 +78,25 @@ def initialize(
         jax.local_device_count(),
         jax.device_count(),
     )
+
+
+def _is_initialized(jax) -> bool:
+    """Best-effort "is the distributed runtime already up?" check, using the
+    public API where this JAX version has one and falling back to the
+    private global state otherwise (the private attribute may move across
+    releases; the fallback failing open just means jax.distributed.initialize
+    itself reports the duplicate initialization)."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if callable(is_init):
+        try:
+            return bool(is_init())
+        except Exception:  # pragma: no cover - defensive
+            pass
+    try:
+        state = getattr(jax._src.distributed, "global_state", None)
+        return state is not None and state.client is not None
+    except Exception:  # pragma: no cover - defensive
+        return False
 
 
 def make_global_mesh(
@@ -167,3 +185,49 @@ def shard_sentences_for_process(
     pc = jax.process_count() if process_count is None else process_count
     per = len(sentences) // pc
     return [sentences[i * pc + pi] for i in range(per)]
+
+
+def shard_flat_for_process(
+    ids: np.ndarray,
+    offsets: np.ndarray,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat-encoding (ids, offsets) variant of
+    :func:`shard_sentences_for_process`: same round-robin split, same
+    drop-the-remainder equal-count contract, without materializing
+    per-sentence Python objects (the streaming fit_file path)."""
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    n = len(offsets) - 1
+    per = n // pc
+    picks = np.arange(per) * pc + pi
+    lens = np.diff(offsets)
+    my_lens = lens[picks]
+    out_offsets = np.zeros(per + 1, dtype=np.int64)
+    np.cumsum(my_lens, out=out_offsets[1:])
+    out_ids = np.empty(int(my_lens.sum()), dtype=np.int32)
+    for j, si in enumerate(picks):
+        out_ids[out_offsets[j] : out_offsets[j + 1]] = ids[
+            offsets[si] : offsets[si + 1]
+        ]
+    return out_ids, out_offsets
+
+
+def per_process_word_counts(
+    sentence_lengths: np.ndarray, process_count: int
+) -> np.ndarray:
+    """Word count each process's shard will hold under the round-robin
+    split — computable on EVERY host with no communication (each host sees
+    the full corpus; only its own slice is materialized). The max of these
+    fixes the per-epoch step count every process must dispatch (SPMD
+    lockstep: a host short on batches pads zero-mask steps up to it)."""
+    lens = np.asarray(sentence_lengths, dtype=np.int64)
+    pc = int(process_count)
+    per = len(lens) // pc
+    return np.array(
+        [int(lens[pi : per * pc : pc].sum()) for pi in range(pc)],
+        dtype=np.int64,
+    )

@@ -502,11 +502,28 @@ class EmbeddingEngine:
         ``adjust`` round trip (mllib:421-425). Batch rows must be divisible
         by the data-axis size.
         """
-        centers = jnp.asarray(centers)
+        centers = np.asarray(centers)
         return self.train_step_grouped(
-            centers[:, None], jnp.ones_like(centers, dtype=jnp.float32)[:, None],
+            centers[:, None], np.ones_like(centers, dtype=np.float32)[:, None],
             contexts, mask, key, alpha,
         )
+
+    def _device_batch(self, *host_arrays, data_axis: int):
+        """Place host batch arrays on the mesh. Single-process: plain
+        ``jnp.asarray`` (jit shards them). Multi-host: each process passes
+        only ITS data-axis rows; the global batch is assembled with every
+        shard staying on the host that produced it
+        (distributed.make_global_batch — the Spark partition-locality
+        analogue, mllib:345)."""
+        if jax.process_count() > 1:
+            from glint_word2vec_tpu.parallel.distributed import (
+                make_global_batch,
+            )
+
+            return make_global_batch(
+                self.mesh, *host_arrays, data_axis=data_axis
+            )
+        return tuple(jnp.asarray(a) for a in host_arrays)
 
     def train_step_grouped(
         self, center_groups, group_mask, contexts, mask, key, alpha
@@ -515,18 +532,21 @@ class EmbeddingEngine:
         of its group's syn0 rows (fastText subword composition; the center
         gradient splits 1/count over the group's rows). Word-level training
         is the width-1 special case used by :meth:`train_step`."""
-        B = center_groups.shape[0]
+        cg, gm, cx, mk = self._device_batch(
+            np.asarray(center_groups),
+            np.asarray(group_mask, dtype=np.float32),
+            np.asarray(contexts),
+            np.asarray(mask, dtype=np.float32),
+            data_axis=0,
+        )
+        B = cg.shape[0]
         if B % self.num_data:
             raise ValueError(
                 f"batch size {B} not divisible by data axis {self.num_data}"
             )
         self.syn0, self.syn1, loss = self._train_step(
             self.syn0, self.syn1, self._prob, self._alias,
-            jnp.asarray(center_groups),
-            jnp.asarray(group_mask, dtype=jnp.float32),
-            jnp.asarray(contexts),
-            jnp.asarray(mask, dtype=jnp.float32), key,
-            jnp.float32(alpha),
+            cg, gm, cx, mk, key, jnp.float32(alpha),
         )
         self._norms_cache = None
         return loss
@@ -547,11 +567,11 @@ class EmbeddingEngine:
         step pays one host round-trip per K minibatches, with all K updates
         running back-to-back on device.
         """
-        centers_k = jnp.asarray(centers_k)
+        centers_k = np.asarray(centers_k)
         K, B = centers_k.shape[0], centers_k.shape[1]
         return self.train_steps_grouped(
             centers_k[:, :, None],
-            jnp.ones((K, B, 1), dtype=jnp.float32),
+            np.ones((K, B, 1), dtype=np.float32),
             contexts_k, mask_k, base_key, alphas, step0,
         )
 
@@ -560,18 +580,25 @@ class EmbeddingEngine:
         alphas, step0: int = 0
     ) -> jax.Array:
         """Grouped-center (subword) variant of :meth:`train_steps`:
-        ``center_groups_k (K, B, S)``, ``group_mask_k (K, B, S)``."""
-        B = center_groups_k.shape[1]
+        ``center_groups_k (K, B, S)``, ``group_mask_k (K, B, S)``. Under
+        multi-host each process passes its own data-axis slice of every
+        step's batch (B here = local rows); the global batch is assembled
+        across processes before dispatch."""
+        cg, gm, cx, mk = self._device_batch(
+            np.asarray(center_groups_k),
+            np.asarray(group_mask_k, dtype=np.float32),
+            np.asarray(contexts_k),
+            np.asarray(mask_k, dtype=np.float32),
+            data_axis=1,
+        )
+        B = cg.shape[1]
         if B % self.num_data:
             raise ValueError(
                 f"batch size {B} not divisible by data axis {self.num_data}"
             )
         self.syn0, self.syn1, losses = self._train_scan(
             self.syn0, self.syn1, self._prob, self._alias,
-            jnp.asarray(center_groups_k),
-            jnp.asarray(group_mask_k, dtype=jnp.float32),
-            jnp.asarray(contexts_k),
-            jnp.asarray(mask_k, dtype=jnp.float32),
+            cg, gm, cx, mk,
             base_key, jnp.uint32(step0),
             jnp.asarray(alphas, dtype=jnp.float32),
         )

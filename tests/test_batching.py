@@ -148,3 +148,52 @@ def test_batcher_validates_args():
         SkipGramBatcher([], v, 0, 2)
     with pytest.raises(ValueError):
         SkipGramBatcher([], v, 8, 0)
+
+
+def test_words_done_ramps_within_epoch_all_paths():
+    """Every batch must carry a words_done close to the words actually
+    consumed up to that batch — NOT the end-of-block/epoch count. A flat
+    count collapses the linear LR anneal to one alpha per epoch (and the
+    floor for the last epoch), silently killing half the training."""
+    rng = np.random.default_rng(3)
+    words = [f"w{i}" for i in range(50)]
+    sents_txt = [list(rng.choice(words, size=20)) for _ in range(400)]
+    v = build_vocab(sents_txt, min_count=1)
+    total = v.train_words_count
+
+    from glint_word2vec_tpu.corpus.batching import encode_sentences
+
+    encoded = encode_sentences(sents_txt, v)
+
+    for path in ("native", "python"):
+        b = SkipGramBatcher(encoded, v, 128, 3, seed=1)
+        it = b.epoch(0) if path == "native" else b._epoch_python(0)
+        batches = list(it)
+        assert len(batches) > 10
+        wds = [x.words_done for x in batches]
+        assert wds == sorted(wds)  # monotone
+        assert wds[-1] == total
+        # The first batch must not already claim (almost) the whole epoch.
+        assert wds[0] < 0.2 * total, (path, wds[0], total)
+        # Midpoint batch carries roughly half the words (pro-rata ramp).
+        mid = wds[len(wds) // 2]
+        assert 0.3 * total < mid < 0.7 * total, (path, mid, total)
+
+
+def test_epoch_python_supports_from_flat():
+    # The python fallback must work for streaming (from_flat) batchers:
+    # it is the path taken when the native lib is unavailable.
+    rng = np.random.default_rng(5)
+    words = [f"w{i}" for i in range(20)]
+    sents_txt = [list(rng.choice(words, size=10)) for _ in range(50)]
+    v = build_vocab(sents_txt, min_count=1)
+
+    from glint_word2vec_tpu.corpus.batching import encode_sentences
+
+    encoded = encode_sentences(sents_txt, v)
+    ids = np.concatenate(encoded).astype(np.int32)
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(s) for s in encoded], out=offsets[1:])
+    b = SkipGramBatcher.from_flat(ids, offsets, v, batch_size=32, window=3, seed=1)
+    batches = list(b._epoch_python(0))
+    assert batches and b.words_done == v.train_words_count

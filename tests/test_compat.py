@@ -116,3 +116,27 @@ def test_save_load_roundtrip(fitted, tmp_path):
     with pytest.raises(ValueError, match="parameterServerHost"):
         ServerSideGlintWord2VecModel.load(path, parameterServerHost="h")
     loaded.stop(terminateOtherClients=True)
+
+
+def test_compat_fit_rounds_indivisible_batch(tiny_corpus):
+    # Reference-valid config: batchSize=50 with numPartitions=4 (per-worker
+    # batch semantics there). The compat layer must round the global batch
+    # up to the data axis with a warning, not raise mid-fit.
+    import warnings
+
+    from glint_word2vec_tpu.compat import ServerSideGlintWord2Vec
+
+    est = (
+        ServerSideGlintWord2Vec()
+        .setVectorSize(8)
+        .setBatchSize(50)
+        .setNumPartitions(4)
+        .setNumParameterServers(1)
+        .setMinCount(5)
+        .setSeed(1)
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        model = est.fit(tiny_corpus[:500])
+    assert any("rounding up to 52" in str(x.message) for x in w)
+    assert len(model.findSynonymsArray("austria", 3)) == 3

