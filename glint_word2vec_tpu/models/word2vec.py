@@ -459,13 +459,15 @@ class Word2Vec:
                         break
                     # Lockstep padding: this host's shard is exhausted but
                     # other hosts still have batches — keep dispatching
-                    # zero-mask groups up to the agreed count. These are
-                    # no-op steps: excluded from metrics (n_real=0) so they
-                    # don't deflate loss curves or inflate step counts.
-                    group = [_zero_batch()]
+                    # zero-mask groups up to the agreed count. Exactly spc
+                    # batches (the scan length every host dispatches) so
+                    # batch stacks, alphas, and PRNG key advancement stay
+                    # in lockstep; excluded from metrics (n_real=0) so
+                    # no-op steps don't deflate loss curves.
+                    group = [_zero_batch()] * spc
                     pad_only = True
                 n_real = 0 if pad_only else len(group)
-                if n_real < spc:
+                if not pad_only and n_real < spc:
                     # Pad the epoch-tail group to the full scan length so
                     # the jitted scan never sees a second K (XLA compiles
                     # are expensive). Zero-mask batches update nothing.

@@ -208,11 +208,16 @@ def shard_flat_for_process(
     my_lens = lens[picks]
     out_offsets = np.zeros(per + 1, dtype=np.int64)
     np.cumsum(my_lens, out=out_offsets[1:])
-    out_ids = np.empty(int(my_lens.sum()), dtype=np.int32)
-    for j, si in enumerate(picks):
-        out_ids[out_offsets[j] : out_offsets[j + 1]] = ids[
-            offsets[si] : offsets[si + 1]
-        ]
+    total = int(my_lens.sum())
+    # Vectorized shard copy (this is the streaming path built for corpora
+    # with tens of millions of sentences — a per-sentence Python loop here
+    # would dominate every fit_file start): source index of each output
+    # word = its sentence's source start + its position within the sentence.
+    src_start = np.repeat(offsets[picks], my_lens)
+    pos_in_sent = np.arange(total, dtype=np.int64) - np.repeat(
+        out_offsets[:-1], my_lens
+    )
+    out_ids = np.ascontiguousarray(ids[src_start + pos_in_sent], dtype=np.int32)
     return out_ids, out_offsets
 
 

@@ -1,0 +1,161 @@
+"""Reference-corpus quality evaluation: the reference's own behavioral gates
+plus an analogy-accuracy artifact with a single-node baseline comparison.
+
+Trains on the reference's integration-test fixture corpus (German Wikipedia
+country/capital articles, ServerSideGlintWord2VecSpec.scala:22-37) and
+checks the reference's exact quality bar:
+
+  gate 1: "wien" in top-10 synonyms of "österreich", cosine > 0.9
+          (Spec.scala:297-302)
+  gate 2: "berlin" in top-10 of wien - österreich + deutschland, cos > 0.9
+          (Spec.scala:342-348)
+
+plus country:capital analogy accuracy over every ordered pair of the six
+countries in the corpus, for:
+
+  * the distributed config (("data","model") = (2,2) mesh — the analogue of
+    the reference test's 2 partitions + 2 parameter servers, Spec.scala:90-94)
+  * a single-node control (1x1 mesh, reference-sized batch=50 minibatches —
+    the "single-node baseline" of BASELINE.json's quality target)
+
+Writes QUALITY.json at the repo root and prints it. Run:
+    python scripts/reference_quality.py [--corpus PATH] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Force CPU: this is a quality evaluation, not a perf run, and it must not
+# block on (or occupy) an accelerator. Override with GLINT_EVAL_PLATFORM.
+os.environ["JAX_PLATFORMS"] = os.environ.get("GLINT_EVAL_PLATFORM", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+
+#: (country, capital) pairs present in the corpus above min_count=5.
+PAIRS = [
+    ("deutschland", "berlin"),
+    ("österreich", "wien"),
+    ("frankreich", "paris"),
+    ("spanien", "madrid"),
+    ("finnland", "helsinki"),
+    ("großbritannien", "london"),
+]
+
+
+def analogy_questions():
+    """a:b :: c:d rows — capital-of analogies over every ordered pair."""
+    qs = []
+    for c1, k1 in PAIRS:
+        for c2, k2 in PAIRS:
+            if c1 != c2:
+                qs.append((c1, k1, c2, k2))
+    return [("capital-of", qs)]
+
+
+def gates(model) -> dict:
+    syn = model.find_synonyms("österreich", 10)
+    wien = dict(syn).get("wien")
+    va = (
+        model.transform("wien")
+        - model.transform("österreich")
+        + model.transform("deutschland")
+    )
+    ana = dict(model.find_synonyms_vector(va, 10))
+    berlin = ana.get("berlin")
+    return {
+        "wien_top10_cos": wien and round(float(wien), 4),
+        "berlin_top10_cos": berlin and round(float(berlin), 4),
+        "gate_synonym": bool(wien is not None and wien > 0.9),
+        "gate_analogy": bool(berlin is not None and berlin > 0.9),
+    }
+
+
+def run(corpus: str, out_path: str) -> dict:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.eval import evaluate_analogies
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    questions = analogy_questions()
+    results = {"corpus": corpus, "pairs": len(PAIRS)}
+
+    configs = {
+        # The distributed estimator under test: TPU-shaped batch on the
+        # 2-partition x 2-shard mesh mirroring the reference test topology.
+        "distributed_2x2": dict(
+            mesh=(2, 2), vector_size=100, step_size=0.025, batch_size=256,
+            min_count=5, num_iterations=2, seed=1, steps_per_call=16,
+        ),
+        # Single-node baseline: reference-sized minibatches (batchSize=50,
+        # mllib:70) on one device — many small sequential SGD steps, the
+        # regime the reference's async workers each run in.
+        "single_node_baseline": dict(
+            mesh=(1, 1), vector_size=100, step_size=0.025, batch_size=50,
+            min_count=5, num_iterations=2, seed=1, steps_per_call=16,
+        ),
+    }
+
+    for name, cfg in configs.items():
+        cfg = dict(cfg)
+        mesh_shape = cfg.pop("mesh")
+        t0 = time.time()
+        model = Word2Vec(mesh=make_mesh(*mesh_shape), **cfg).fit_file(
+            corpus, lowercase=True
+        )
+        entry = {
+            "config": {**cfg, "mesh": list(mesh_shape)},
+            "train_seconds": round(time.time() - t0, 1),
+            "vocab_size": model.vocab.size,
+            **gates(model),
+            "analogy_top1": evaluate_analogies(model, questions, top_k=1).to_dict(),
+            "analogy_top5": evaluate_analogies(model, questions, top_k=5).to_dict(),
+        }
+        results[name] = entry
+        model.stop()
+        print(f"{name}: {json.dumps(entry)}", flush=True)
+
+    d = results["distributed_2x2"]
+    b = results["single_node_baseline"]
+    results["summary"] = {
+        "reference_gates_pass": d["gate_synonym"] and d["gate_analogy"],
+        "distributed_top1": d["analogy_top1"]["accuracy"],
+        "baseline_top1": b["analogy_top1"]["accuracy"],
+        "distributed_vs_baseline": round(
+            d["analogy_top1"]["accuracy"] - b["analogy_top1"]["accuracy"], 4
+        ),
+        "meets_baseline_target": (
+            d["analogy_top1"]["accuracy"] >= b["analogy_top1"]["accuracy"]
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2, ensure_ascii=False)
+    print(json.dumps(results["summary"]))
+    return results
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "QUALITY.json",
+        ),
+    )
+    a = ap.parse_args()
+    run(a.corpus, a.out)

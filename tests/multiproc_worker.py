@@ -48,11 +48,17 @@ def main() -> int:
     from glint_word2vec_tpu import Word2Vec
 
     # Deterministic corpus, built identically on every process (the
-    # shared-corpus contract of multi-host fit()).
+    # shared-corpus contract of multi-host fit()). Sentence lengths are
+    # deliberately skewed by position so the round-robin shards have very
+    # different word counts: the word-light host MUST exercise the lockstep
+    # zero-mask padding path (including whole pad-only groups), the
+    # riskiest part of the multi-host loop. Odd sentence count also covers
+    # the drop-the-remainder split.
     rng = np.random.default_rng(7)
     words = [f"w{i}" for i in range(40)]
     sentences = [
-        [str(w) for w in rng.choice(words, size=10)] for _ in range(300)
+        [str(w) for w in rng.choice(words, size=(20 if i % 2 == 0 else 4))]
+        for i in range(301)
     ]
 
     common = dict(

