@@ -162,16 +162,13 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
 
 
 def worker_main() -> None:
-    if os.environ.get("BENCH_PLATFORM"):
-        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    from glint_word2vec_tpu.utils.platform import force_platform
+
+    # The env var alone is not enough under environments that pre-register
+    # a remote TPU backend and pin jax_platforms at interpreter start.
+    force_platform(os.environ.get("BENCH_PLATFORM"))
     import numpy as np
     import jax
-
-    if os.environ.get("BENCH_PLATFORM"):
-        # The env var alone is not enough under environments that
-        # pre-register a remote TPU backend and pin jax_platforms at
-        # interpreter start; the config update must win.
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
     from glint_word2vec_tpu.parallel.mesh import make_mesh
 

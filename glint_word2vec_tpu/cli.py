@@ -111,15 +111,9 @@ def main(argv=None) -> int:
 
 
 def _run(args) -> int:
-    import os
+    from glint_word2vec_tpu.utils.platform import force_platform
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # Make the env var authoritative even in environments that pre-pin
-        # jax_platforms at interpreter start (where the config default has
-        # already been read past); a plain `JAX_PLATFORMS=cpu` must work.
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    force_platform()  # a plain `JAX_PLATFORMS=cpu` must always work
 
     from glint_word2vec_tpu import FastTextWord2Vec, Word2Vec, load_model
 

@@ -1,0 +1,31 @@
+"""Backend-platform selection that works under pinned platform lists.
+
+``JAX_PLATFORMS`` is normally read once, as the *default* of the
+``jax_platforms`` config value, when JAX's config initializes. Environments
+that pre-register an accelerator backend at interpreter start (site hooks)
+can pin the config past that point, after which the env var is silently
+ignored — a plain ``JAX_PLATFORMS=cpu python ...`` then still blocks on the
+accelerator tunnel. The fix is to re-assert the value through
+``jax.config.update`` after importing jax; this helper is the one shared
+implementation of that idiom (used by the CLI, bench.py, and scripts/).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_platform(platform: Optional[str] = None) -> None:
+    """Make ``platform`` (or ``$JAX_PLATFORMS`` if None) authoritative.
+
+    No-op when neither is set. Safe to call before any device use; must be
+    called before the first ``jax.devices()``/computation to take effect.
+    """
+    p = platform or os.environ.get("JAX_PLATFORMS")
+    if not p:
+        return
+    os.environ["JAX_PLATFORMS"] = p
+    import jax
+
+    jax.config.update("jax_platforms", p)

@@ -1,0 +1,110 @@
+"""Measure XLA vs Pallas row-kernel paths on the current device.
+
+The decision record VERDICT asked for: per-hardware step times for the
+sparse row traffic (gather / scatter-add) and the full fused train step
+with the engine's ``use_pallas`` flag off vs on. The winner should be the
+engine default; the loser stays opt-in. Run on the real TPU when available:
+
+    python scripts/pallas_bench.py            # current default backend
+    GLINT_PB_PLATFORM=cpu python scripts/pallas_bench.py   # CPU (interpret)
+
+Prints one JSON line per measurement and a final summary line; paste the
+table into PARITY.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_PB_PLATFORM"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def timed(fn, *args, iters=20, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / iters * 1e6  # us
+
+
+def main() -> None:
+    V = int(os.environ.get("GLINT_PB_VOCAB", 1_000_000))
+    d = int(os.environ.get("GLINT_PB_DIM", 300))
+    N = int(os.environ.get("GLINT_PB_ROWS", 286_720))  # ~B*C*(1+n) at bench shapes
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    interpret = not on_tpu
+    if interpret:
+        # Interpret mode is a semantics check, not a measurement; shrink.
+        V, d, N = min(V, 20_000), min(d, 64), min(N, 4_096)
+
+    from glint_word2vec_tpu.ops.pallas_rows import gather_rows, scatter_add_rows
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+    upd = jnp.asarray(rng.normal(size=(N, d)).astype(np.float32) * 1e-3)
+
+    results = {"platform": dev.platform, "device_kind": dev.device_kind,
+               "V": V, "d": d, "N": N}
+
+    xla_gather = jax.jit(lambda t, i: t[i])
+    results["gather_xla_us"] = round(timed(xla_gather, table, ids), 1)
+    for br in (8, 16, 32):
+        results[f"gather_pallas_b{br}_us"] = round(
+            timed(gather_rows, table, ids, interpret=interpret, block_rows=br), 1
+        )
+
+    xla_scatter = jax.jit(lambda t, i, u: t.at[i].add(u))
+    results["scatter_xla_us"] = round(timed(xla_scatter, table, ids, upd), 1)
+    for br in (8, 16, 32):
+        results[f"scatter_pallas_b{br}_us"] = round(
+            timed(
+                scatter_add_rows, table, ids, upd,
+                interpret=interpret, block_rows=br,
+            ),
+            1,
+        )
+
+    # Full fused train step, engine-level: default vs pallas path.
+    if on_tpu:
+        from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+        from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+        counts = np.maximum(1e9 / np.arange(1, V + 1), 1.0).astype(np.int64)
+        B, C, spc = 8192, 7, 16
+        centers = rng.integers(0, V, size=(spc, B)).astype(np.int32)
+        contexts = rng.integers(0, V, size=(spc, B, C)).astype(np.int32)
+        mask = np.ones((spc, B, C), np.float32)
+        alphas = np.full(spc, 0.025, np.float32)
+        key = jax.random.PRNGKey(0)
+        for use_pallas in (False, True):
+            eng = EmbeddingEngine(
+                make_mesh(1, 1, devices=[dev]), V, d, counts,
+                use_pallas=use_pallas,
+            )
+            us = timed(
+                eng.train_steps, centers, contexts, mask, key, alphas, 0,
+                iters=5,
+            )
+            results[f"train_step_{'pallas' if use_pallas else 'xla'}_us"] = (
+                round(us / spc, 1)
+            )
+            del eng
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

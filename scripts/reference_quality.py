@@ -81,10 +81,9 @@ def gates(model) -> dict:
 
 
 def run(corpus: str, out_path: str) -> dict:
-    import jax
+    from glint_word2vec_tpu.utils.platform import force_platform
 
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    force_platform()
 
     from glint_word2vec_tpu import Word2Vec
     from glint_word2vec_tpu.eval import evaluate_analogies

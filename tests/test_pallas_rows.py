@@ -106,3 +106,51 @@ def test_engine_pallas_mode_matches_default():
         np.asarray(eng.pull(np.arange(5, dtype=np.int32))),
         rtol=1e-6,
     )
+
+
+@pytest.mark.parametrize("block_rows", [4, 8])
+@pytest.mark.parametrize("n", [1, 7, 8, 9, 31])
+def test_scatter_block_boundary_runs(block_rows, n):
+    """Runs of equal ids spanning grid-step boundaries, pad rows extending
+    the final run, and N not divisible by block_rows must all still SUM:
+    the multi-row kernel's riskiest cases (sequential-step RMW ordering and
+    the edge-padding rule)."""
+    rng = np.random.default_rng(n * 31 + block_rows)
+    table = rng.normal(size=(V, D)).astype(np.float32)
+    # Long runs: few distinct ids so runs routinely cross block boundaries.
+    ids = np.sort(rng.integers(0, 3, n).astype(np.int32))
+    upd = rng.normal(size=(n, D)).astype(np.float32)
+    out = scatter_add_rows(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(upd),
+        interpret=True, block_rows=block_rows,
+    )
+    expected = jnp.asarray(table).at[jnp.asarray(ids)].add(jnp.asarray(upd))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("n", [1, 15, 16, 33])
+def test_gather_non_multiple_sizes(n):
+    rng = np.random.default_rng(n)
+    table = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, V, n).astype(np.int32))
+    out = gather_rows(table, ids, interpret=True, block_rows=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(table)[ids])
+
+
+def test_scatter_single_id_whole_batch():
+    # Every update targets one row (the worst-case hot-row skew): one run
+    # spanning every block.
+    rng = np.random.default_rng(9)
+    table = rng.normal(size=(V, D)).astype(np.float32)
+    ids = np.full(29, 5, np.int32)
+    upd = rng.normal(size=(29, D)).astype(np.float32)
+    out = scatter_add_rows(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(upd),
+        interpret=True, block_rows=8,
+    )
+    expected = jnp.asarray(table).at[jnp.asarray(ids)].add(jnp.asarray(upd))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-4, atol=1e-4
+    )
