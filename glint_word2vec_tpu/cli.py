@@ -82,6 +82,16 @@ def _add_query(sub):
     p.add_argument("--model", required=True)
 
     p = sub.add_parser(
+        "serve",
+        help="serve a saved model over HTTP (the separate-PS-cluster "
+             "deployment analogue: trainers/clients come and go, the "
+             "model stays resident)",
+    )
+    p.add_argument("--model", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8801)
+
+    p = sub.add_parser(
         "eval", help="analogy accuracy on a standard question file"
     )
     p.add_argument("--model", required=True)
@@ -153,6 +163,12 @@ def _run(args) -> int:
         if args.metrics_out:
             with open(args.metrics_out, "w") as f:
                 json.dump(model.training_metrics, f)
+        return 0
+
+    if args.cmd == "serve":
+        from glint_word2vec_tpu.serving import serve_model_dir
+
+        serve_model_dir(args.model, host=args.host, port=args.port)
         return 0
 
     model = load_model(args.model)

@@ -1,0 +1,163 @@
+"""Persistent model serving: the separate-PS-cluster deployment, restated.
+
+The reference's second deployment topology keeps a Glint parameter-server
+cluster alive independently of any one training/serving app
+(README.md:45-57: `glint.Main` launched standalone; trainers and
+transformers connect by host and come and go; the cluster survives
+`model.stop()` unless a client passes ``terminateOtherClients=true``,
+mllib:664-667). The TPU-native restatement: the model lives in one serving
+process's device memory, exposed over HTTP; client apps (trainers, batch
+jobs, notebooks) query it without loading the tables themselves, and their
+lifecycles don't affect it.
+
+Endpoints (JSON in/out, stdlib-only server):
+
+  GET  /healthz            -> {"status": "ok", "vocab_size": V, "dim": d, ...}
+  POST /synonyms           {"word": w, "num": k}
+  POST /synonyms_vector    {"vector": [...], "num": k}
+  POST /analogy            {"positive": [...], "negative": [...], "num": k}
+  POST /vector             {"word": w}            (strict OOV -> 404)
+  POST /transform          {"sentences": [[w, ...], ...]}  (OOV dropped)
+  POST /shutdown           stops the server (the terminateOtherClients
+                           analogue: an explicit, remote, cross-client kill)
+
+Start from the CLI:  glint-word2vec-tpu serve --model DIR --port 8801
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ModelServer:
+    """Holds one loaded model and serves its query surface over HTTP."""
+
+    def __init__(self, model, host: str = "127.0.0.1", port: int = 8801):
+        self.model = model
+        # Device queries are jitted functions on shared tables; serialize
+        # them (the reference's PS likewise processes a shard's requests
+        # on its actor mailbox, one at a time).
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                logger.debug("serve: " + fmt, *args)
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    m = server.model
+                    self._send(
+                        200,
+                        {
+                            "status": "ok",
+                            "family": type(m).__name__,
+                            "vocab_size": m.vocab.size,
+                            "dim": m.vector_size,
+                        },
+                    )
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                try:
+                    with server._lock:
+                        out = server._dispatch(self.path, req)
+                except KeyError as e:
+                    return self._send(
+                        404, {"error": e.args[0] if e.args else str(e)}
+                    )
+                except ValueError as e:
+                    return self._send(400, {"error": str(e)})
+                if out is None:
+                    return self._send(404, {"error": f"no route {self.path}"})
+                self._send(200, out)
+                if self.path == "/shutdown":
+                    threading.Thread(target=server.stop, daemon=True).start()
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request dispatch ---------------------------------------------
+
+    def _dispatch(self, path: str, req: dict):
+        m = self.model
+        if path == "/synonyms":
+            return [
+                [w, float(s)]
+                for w, s in m.find_synonyms(req["word"], int(req.get("num", 10)))
+            ]
+        if path == "/synonyms_vector":
+            vec = np.asarray(req["vector"], np.float32)
+            return [
+                [w, float(s)]
+                for w, s in m.find_synonyms_vector(vec, int(req.get("num", 10)))
+            ]
+        if path == "/analogy":
+            return [
+                [w, float(s)]
+                for w, s in m.analogy(
+                    req.get("positive", []),
+                    req.get("negative", []),
+                    int(req.get("num", 10)),
+                )
+            ]
+        if path == "/vector":
+            return [float(x) for x in m.transform(req["word"])]
+        if path == "/transform":
+            vecs = m.transform_sentences(req["sentences"])
+            return [[float(x) for x in v] for v in np.asarray(vecs)]
+        if path == "/shutdown":
+            return {"status": "shutting down"}
+        return None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def serve_forever(self) -> None:
+        logger.info("serving model on %s:%d", self.host, self.port)
+        self._httpd.serve_forever()
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_model_dir(
+    model_dir: str, host: str = "127.0.0.1", port: int = 8801
+) -> None:
+    """Load a saved model (any family) and serve it until killed."""
+    from glint_word2vec_tpu import load_model
+
+    server = ModelServer(load_model(model_dir), host=host, port=port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()

@@ -1,0 +1,151 @@
+"""Large-vocabulary single-chip scale test: throughput + quality + HBM.
+
+BASELINE.json's config #2 scaled to one chip: 1M-vocab, d=300 tables
+(bfloat16 by default) — the table geometry of the 10M-vocab pod target at
+1/10 scale. To keep QUALITY measurable without a web-scale corpus (this
+container has only the reference fixture on disk), the real corpus trains
+against tables padded with synthetic low-count vocabulary rows: the real
+words' rows behave exactly as at small scale except that negative draws now
+come from the full 1M-row noise distribution, and the tables/gather/
+scatter/top-k all run at the target geometry. Records:
+
+  * sustained training words/sec at the scale geometry
+  * the reference quality gates (wien/berlin, cos > 0.9)
+  * device memory stats (bytes_in_use / peak) where the backend reports them
+
+Writes SCALE.json at the repo root. CPU smoke: GLINT_SCALE_PLATFORM=cpu
+shrinks to a 50k-row geometry (the mechanism test; the numbers only mean
+something on the TPU).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_SCALE_PLATFORM"))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+DEFAULT_CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    V_target = int(os.environ.get("GLINT_SCALE_VOCAB", 1_000_000 if on_tpu else 50_000))
+    d = int(os.environ.get("GLINT_SCALE_DIM", 300 if on_tpu else 64))
+    dtype = os.environ.get("GLINT_SCALE_DTYPE", "bfloat16")
+    # The quality-validated gate config (QUALITY.json) uses batch 256 x 2
+    # epochs; keep the scale run in that regime rather than a throughput-
+    # maximizing batch (throughput at big batches is bench.py's job).
+    batch = int(os.environ.get("GLINT_SCALE_BATCH", 256 if on_tpu else 512))
+    epochs = int(os.environ.get("GLINT_SCALE_EPOCHS", 3))
+
+    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.corpus.vocab import (
+        Vocabulary, build_vocab, encode_file, iter_text_file,
+    )
+    from glint_word2vec_tpu.corpus.batching import SkipGramBatcher
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    corpus = os.environ.get("GLINT_SCALE_CORPUS", DEFAULT_CORPUS)
+    real = build_vocab(iter_text_file(corpus, lowercase=True), min_count=5)
+    pad_n = max(0, V_target - real.size)
+    words = list(real.words) + [f"__pad{i}__" for i in range(pad_n)]
+    # Pad rows get count 0: they are never drawn as negatives (zero noise
+    # mass — the engine's extra_rows semantics), so training statistics
+    # match the real-vocab run while the tables, gathers, scatters, and
+    # the top-k scans all run at the 1M-row target geometry. (Count-1 pads
+    # would soak up ~95% of the unigram^0.75 noise mass and train nothing.)
+    counts = np.concatenate(
+        [real.counts, np.zeros(pad_n, np.int64)]
+    )
+    vocab = Vocabulary(
+        words=words,
+        counts=counts,
+        word_index={w: i for i, w in enumerate(words)},
+        train_words_count=real.train_words_count,
+    )
+    ids, offsets = encode_file(corpus, real, max_sentence_length=1000, lowercase=True)
+
+    w2v = Word2Vec(
+        mesh=make_mesh(1, 1, devices=[dev]), vector_size=d, step_size=0.025,
+        batch_size=batch, min_count=5, num_iterations=epochs, seed=1,
+        steps_per_call=16, dtype=dtype,
+    )
+    batcher = SkipGramBatcher.from_flat(
+        ids, offsets, vocab, batch_size=batch, window=5, seed=1
+    )
+    t0 = time.time()
+    model = w2v._fit_with_batcher(vocab, batcher, None, 1, None)
+    train_s = time.time() - t0
+
+    tm = model.training_metrics
+    syn = dict(model.find_synonyms("österreich", 10))
+    wien = syn.get("wien")
+    va = (
+        model.transform("wien")
+        - model.transform("österreich")
+        + model.transform("deutschland")
+    )
+    ana = dict(model.find_synonyms_vector(va, 10))
+    berlin = ana.get("berlin")
+    # Capital-of analogy accuracy at scale geometry (the committed
+    # accuracy record; the 0.9-cosine gates are a d=100 regime and are
+    # reported informationally here).
+    sys.path_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, sys.path_dir)
+    from reference_quality import analogy_questions  # noqa: E402
+
+    from glint_word2vec_tpu.eval import evaluate_analogies
+
+    top1 = evaluate_analogies(model, analogy_questions(), top_k=1).to_dict()
+    top5 = evaluate_analogies(model, analogy_questions(), top_k=5).to_dict()
+    mem = {}
+    try:
+        stats = dev.memory_stats() or {}
+        mem = {
+            k: int(stats[k])
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+            if k in stats
+        }
+    except Exception:
+        pass
+
+    out = {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "vocab_rows": V_target,
+        "real_vocab": real.size,
+        "dim": d,
+        "dtype": dtype,
+        "batch": batch,
+        "epochs": epochs,
+        "train_seconds": round(train_s, 1),
+        "words_per_sec": tm["words_per_sec"],
+        "steps": tm["steps"],
+        "wien_cos": wien and round(float(wien), 4),
+        "berlin_cos": berlin and round(float(berlin), 4),
+        "gate_synonym": bool(wien is not None and wien > 0.9),
+        "gate_analogy": bool(berlin is not None and berlin > 0.9),
+        "analogy_top1": top1["accuracy"],
+        "analogy_top5": top5["accuracy"],
+        "memory": mem,
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "SCALE.json"
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    model.stop()
+
+
+if __name__ == "__main__":
+    main()

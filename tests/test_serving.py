@@ -1,0 +1,74 @@
+"""Model-serving tests: the separate-PS-cluster deployment analogue
+(README.md:45-57 of the reference; serving.py module docstring)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu import Word2Vec
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.serving import ModelServer
+
+
+@pytest.fixture(scope="module")
+def served(tiny_corpus):
+    model = Word2Vec(
+        mesh=make_mesh(1, 2), vector_size=16, min_count=5, batch_size=128,
+        seed=2, num_iterations=2,
+    ).fit(tiny_corpus)
+    server = ModelServer(model, port=0)  # ephemeral port
+    server.start_background()
+    yield server, model
+    server.stop()
+    model.stop()
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_healthz_and_queries(served):
+    server, model = served
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/healthz", timeout=30
+    ) as r:
+        health = json.loads(r.read())
+    assert health["status"] == "ok"
+    assert health["vocab_size"] == model.vocab.size
+
+    syn = _post(server, "/synonyms", {"word": "austria", "num": 5})
+    assert len(syn) == 5
+    # Served results identical to in-process queries (same tables).
+    direct = model.find_synonyms("austria", 5)
+    assert [w for w, _ in direct] == [w for w, _ in syn]
+
+    vec = _post(server, "/vector", {"word": "vienna"})
+    np.testing.assert_allclose(vec, model.transform("vienna"), rtol=1e-6)
+
+    ana = _post(
+        server, "/analogy",
+        {"positive": ["vienna", "germany"], "negative": ["austria"], "num": 3},
+    )
+    assert len(ana) == 3
+
+    emb = _post(server, "/transform", {"sentences": [["austria", "zzz"]]})
+    assert len(emb) == 1 and len(emb[0]) == 16
+
+
+def test_error_paths(served):
+    server, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/vector", {"word": "notaword_xyz"})
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/nosuchroute", {})
+    assert e.value.code == 404
