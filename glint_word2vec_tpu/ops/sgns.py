@@ -29,7 +29,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from glint_word2vec_tpu.ops.sampling import sample_negatives
+from glint_word2vec_tpu.ops.sampling import sample_negatives_per_row
 
 
 class SgnsGrads(NamedTuple):
@@ -219,7 +219,9 @@ def train_step(
     reference's async Hogwild races (SURVEY.md §2.3, §7 hard part 3).
     """
     B, C = contexts.shape
-    negs = sample_negatives(key, prob, alias, (B, C, num_negatives))
+    negs = sample_negatives_per_row(
+        key, prob, alias, jnp.arange(B, dtype=jnp.int32), (C, num_negatives)
+    )
     compute = jnp.float32
     h = syn0[centers].astype(compute)
     u_pos = syn1[contexts].astype(compute)
@@ -254,7 +256,9 @@ def sgns_loss(
 ) -> jax.Array:
     """Forward-only masked-mean SGNS loss (the jittable inference/eval fn)."""
     B, C = contexts.shape
-    negs = sample_negatives(key, prob, alias, (B, C, num_negatives))
+    negs = sample_negatives_per_row(
+        key, prob, alias, jnp.arange(B, dtype=jnp.int32), (C, num_negatives)
+    )
     h = syn0[centers].astype(jnp.float32)
     u_pos = syn1[contexts].astype(jnp.float32)
     u_neg = syn1[negs].astype(jnp.float32)

@@ -21,18 +21,24 @@ maps to a jitted SPMD function:
 Communication design: a ``psum`` over the "model" axis replaces the
 client<->server pull round-trip (each shard contributes its owned rows,
 zeros elsewhere); an ``all_gather`` over the "data" axis replaces the
-async gradient push — per-step traffic stays O(batch * d), never O(vocab),
-preserving the CIKM'16 network-efficiency property in spirit (SURVEY.md
-§3.1). There is no message-size ceiling, so the reference's
-``GranularBigWord2VecMatrix`` splitter (mllib:83-85,362) has no analogue;
-request batching survives only as ``max_query_rows`` chunking in the model
-layer to bound HBM spikes.
+async gradient push. The data-axis exchange carries ONLY the batch's
+center representations ``h`` (B x d), the scalar gradient coefficients
+(the reference's gPlus/gMinus payload, mllib:422-425), and int32 indices —
+O(batch * (d + pairs)) bytes, never the O(batch * pairs * d) expanded
+rank-1 updates, and never O(vocab). Consuming shards re-form the
+``coef x h`` outer products locally, fused by XLA into the scatter-add
+(locked in by the HLO-bytes test, tests/test_engine.py). There is no
+message-size ceiling, so the reference's ``GranularBigWord2VecMatrix``
+splitter (mllib:83-85,362) has no analogue; request batching survives only
+as ``max_query_rows`` chunking in the model layer to bound HBM spikes.
 
-Negative sampling is mesh-invariant: every rank draws the *full* batch's
-negatives from the shared per-step key and slices its data-shard — the
-same (key -> negatives) contract the reference implements by broadcasting
-a seed to all servers (``dotprod(..., seed)``, mllib:420-421) — so results
-are bitwise-independent of mesh shape up to float reduction order.
+Negative sampling is mesh-invariant AND shard-local: each rank derives
+per-row keys from the shared per-step key and its rows' GLOBAL batch
+indices (``fold_in(key, global_row)``), reproducing exactly the draws any
+other mesh shape makes for the same rows — the (seed -> identical
+negatives) contract the reference implements by broadcasting a seed to
+all servers (``dotprod(..., seed)``, mllib:420-421) — while sampling only
+O(local rows) draws.
 """
 
 from __future__ import annotations
@@ -54,7 +60,10 @@ except ImportError:  # pragma: no cover
 
 from glint_word2vec_tpu.corpus.alias import build_unigram_alias
 from glint_word2vec_tpu.ops import sgns
-from glint_word2vec_tpu.ops.sampling import sample_negatives
+from glint_word2vec_tpu.ops.sampling import (
+    sample_negatives,
+    sample_negatives_per_row,
+)
 from glint_word2vec_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -243,6 +252,14 @@ class EmbeddingEngine:
             u_pos = _pull_rows(syn1_l, contexts.reshape(-1), start, Vs, pm)
             u_pos = u_pos.reshape(Bl, C, -1)
 
+            # The data-axis exchange ships ONLY h (B, d), scalar gradient
+            # coefficients, and int32 indices — the TPU restatement of the
+            # reference's defining ship-scalars property (gPlus/gMinus,
+            # mllib:422-425). The O(B*C*(1+n)*d) rank-1 payloads are never
+            # exchanged: every consuming shard re-forms coef x h outer
+            # products locally, where XLA fuses them into the scatter-add.
+            h_g = lax.all_gather(h, DATA_AXIS, tiled=True)  # (B, d)
+
             if self.shared_negatives:
                 # Shared-pool mode: ONE pool of P negatives per step,
                 # identical on every rank (drawn from the shared key — the
@@ -258,7 +275,6 @@ class EmbeddingEngine:
                     h, u_pos, u_pool, mask, collide,
                     alpha.astype(jnp.float32), n,
                 )
-                d_upos = g.c_pos[..., None] * h[:, None, :]
                 # The pool update sums contributions from every data rank;
                 # after the psum it is identical everywhere, so each model
                 # shard applies its owned slice exactly once per replica.
@@ -266,20 +282,20 @@ class EmbeddingEngine:
                 ids1 = lax.all_gather(
                     contexts.reshape(-1), DATA_AXIS, tiled=True
                 )
-                upd1 = lax.all_gather(
-                    d_upos.reshape(Bl * C, -1), DATA_AXIS, tiled=True
-                )
+                cpos_g = lax.all_gather(g.c_pos, DATA_AXIS, tiled=True)
+                d_upos = cpos_g[..., None] * h_g[:, None, :]
                 ids1_g = jnp.concatenate([ids1, pool])
-                upd1_g = jnp.concatenate([upd1, d_pool])
+                upd1_g = jnp.concatenate(
+                    [d_upos.reshape(-1, d_upos.shape[-1]), d_pool]
+                )
             else:
-                # Per-pair mode (reference semantics): n fresh negatives per
-                # (center, context) pair. Mesh-invariant draws: the full
-                # global batch's negatives come from the shared key; each
-                # rank slices its rows (see module docstring).
-                B = Bl * self.num_data
-                negs_full = sample_negatives(key, prob, alias, (B, C, n))
-                negs = lax.dynamic_slice_in_dim(
-                    negs_full, drank * Bl, Bl, axis=0
+                # Per-pair mode (reference semantics): n fresh negatives
+                # per (center, context) pair, keyed by GLOBAL row index so
+                # draws are mesh-invariant while each rank samples only its
+                # own Bl rows (ops.sampling.sample_negatives_per_row).
+                rows_g = drank * Bl + jnp.arange(Bl, dtype=jnp.int32)
+                negs = sample_negatives_per_row(
+                    key, prob, alias, rows_g, (C, n)
                 )
                 u_neg = _pull_rows(syn1_l, negs.reshape(-1), start, Vs, pm)
                 u_neg = u_neg.reshape(Bl, C, n, -1)
@@ -287,27 +303,30 @@ class EmbeddingEngine:
                 g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
                                     alpha.astype(jnp.float32))
 
-                # Rank-1 update payloads (the reference's gPlus/gMinus
-                # scalars expanded client-side, mllib:422-425).
-                d_upos = g.c_pos[..., None] * h[:, None, :]
-                d_uneg = g.c_neg[..., None] * h[:, None, None, :]
-                ids1 = jnp.concatenate(
-                    [contexts.reshape(-1), negs.reshape(-1)]
+                ctx_g = lax.all_gather(contexts, DATA_AXIS, tiled=True)
+                negs_g = lax.all_gather(negs, DATA_AXIS, tiled=True)
+                cpos_g = lax.all_gather(g.c_pos, DATA_AXIS, tiled=True)
+                cneg_g = lax.all_gather(g.c_neg, DATA_AXIS, tiled=True)
+                # Consumer-side outer products (coef x h), rank-major along
+                # the batch axis on every operand, so ids and updates align.
+                d = h_g.shape[-1]
+                d_upos = cpos_g[..., None] * h_g[:, None, :]
+                d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
+                ids1_g = jnp.concatenate(
+                    [ctx_g.reshape(-1), negs_g.reshape(-1)]
                 )
-                upd1 = jnp.concatenate(
-                    [d_upos.reshape(Bl * C, -1),
-                     d_uneg.reshape(Bl * C * n, -1)]
+                upd1_g = jnp.concatenate(
+                    [d_upos.reshape(-1, d), d_uneg.reshape(-1, d)]
                 )
-                ids1_g = lax.all_gather(ids1, DATA_AXIS, tiled=True)
-                upd1_g = lax.all_gather(upd1, DATA_AXIS, tiled=True)
 
             # The center gradient is distributed over the group's rows
-            # (d mean / d row = 1/count); exchange across the data axis,
-            # then each shard applies the slice it owns.
-            d_sub = (g.d_center / cnt)[:, None, :] * cmask[..., None]
+            # (d mean / d row = 1/count): ship the (Bl, d) gradient + the
+            # (Bl, S) group mask, expand to rows at the consumer.
+            dcen_g = lax.all_gather(g.d_center / cnt, DATA_AXIS, tiled=True)
+            cmask_g = lax.all_gather(cmask, DATA_AXIS, tiled=True)
             ids0_g = lax.all_gather(centers.reshape(-1), DATA_AXIS, tiled=True)
-            upd0_g = lax.all_gather(
-                d_sub.reshape(Bl * S, -1), DATA_AXIS, tiled=True
+            upd0_g = (dcen_g[:, None, :] * cmask_g[..., None]).reshape(
+                -1, dcen_g.shape[-1]
             )
             syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs, pm)
             syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs, pm)

@@ -354,3 +354,66 @@ def test_load_tables_geometry_mismatch_raises(tmp_path):
     other = EmbeddingEngine(make_mesh(1, 8), V + 1, D, counts, seed=0)
     with pytest.raises(ValueError, match="geometry"):
         other.load_tables(path)
+
+
+def test_data_axis_exchange_ships_scalars_not_payloads():
+    # Lock in the O(B*(d + pairs)) data-axis exchange (the TPU form of the
+    # reference's ship-scalars-only property, mllib:422-425): total
+    # all-gather output bytes in the compiled step must stay far below the
+    # expanded rank-1 payload B*C*(1+n)*d it used to ship.
+    import re
+
+    B, C, D2 = 16, 5, 64
+    counts = np.arange(V, 0, -1).astype(np.int64) * 10
+    eng = EmbeddingEngine(make_mesh(4, 2), V, D2, counts, num_negatives=4)
+    centers, contexts, mask = _batch(B=B, C=C)
+    cg = jnp.asarray(centers[:, None])
+    gm = jnp.ones((B, 1), jnp.float32)
+    lowered = eng._train_step.lower(
+        eng.syn0, eng.syn1, eng._prob, eng._alias,
+        cg, gm, jnp.asarray(contexts), jnp.asarray(mask),
+        jax.random.PRNGKey(0), jnp.float32(0.05),
+    )
+    hlo = lowered.compile().as_text()
+    gathered = 0
+    for m in re.finditer(
+        r"= (f32|s32|u32|bf16)\[([\d,]*)\][^=]*? all-gather\(", hlo
+    ):
+        dims = [int(x) for x in m.group(2).split(",") if x]
+        elems = int(np.prod(dims)) if dims else 1
+        width = 2 if m.group(1) == "bf16" else 4
+        gathered += elems * width
+    n = eng.num_negatives
+    expanded_payload = B * C * (1 + n) * D2 * 4  # the old exchange, bytes
+    # New exchange: h + d_center (2*B*d) + coefficient scalars + ids +
+    # group mask — all small multiples of B.
+    budget = 4 * (2 * B * D2 + 4 * B * C * (1 + n) + 2 * B) * 2  # 2x slack
+    assert 0 < gathered <= budget, (gathered, budget)
+    assert gathered < expanded_payload / 4, (gathered, expanded_payload)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 1), (4, 2)])
+def test_negative_draws_slice_invariant_across_ranks(shape):
+    # Round-3 directive: per-pair negatives must be drawn per GLOBAL row
+    # (fold_in(key, global_row)) so a rank holding rows [r0, r0+Bl) draws
+    # exactly what a 1-rank run draws for those rows, with no B_global in
+    # any sampled shape.
+    from glint_word2vec_tpu.ops.sampling import sample_negatives_per_row
+
+    t = build_unigram_alias(np.arange(1, V + 1).astype(np.int64))
+    prob, alias = jnp.asarray(t.prob), jnp.asarray(t.alias)
+    key = jax.random.PRNGKey(3)
+    full = np.asarray(
+        sample_negatives_per_row(
+            key, prob, alias, jnp.arange(16, dtype=jnp.int32), (3, 4)
+        )
+    )
+    ranks, _ = shape
+    Bl = 16 // ranks
+    for r in range(ranks):
+        rows = jnp.arange(r * Bl, (r + 1) * Bl, dtype=jnp.int32)
+        part = np.asarray(
+            sample_negatives_per_row(key, prob, alias, rows, (3, 4))
+        )
+        assert part.shape == (Bl, 3, 4)  # local rows only, no B_global
+        np.testing.assert_array_equal(part, full[r * Bl : (r + 1) * Bl])

@@ -11,7 +11,10 @@ import pytest
 
 from glint_word2vec_tpu.corpus import build_unigram_alias
 from glint_word2vec_tpu.ops import sgns
-from glint_word2vec_tpu.ops.sampling import sample_negatives
+from glint_word2vec_tpu.ops.sampling import (
+    sample_negatives,
+    sample_negatives_per_row,
+)
 
 
 def _sigmoid(x):
@@ -68,9 +71,13 @@ def test_train_step_matches_numpy_oracle():
         jnp.asarray(t.alias), jnp.asarray(centers), jnp.asarray(contexts),
         jnp.asarray(mask), key, jnp.float32(alpha), num_negatives=3,
     )
-    # Re-derive the same negatives the step drew, then run the oracle.
+    # Re-derive the same negatives the step drew (per-global-row keys),
+    # then run the oracle.
     negs = np.asarray(
-        sample_negatives(key, jnp.asarray(t.prob), jnp.asarray(t.alias), (6, 4, 3))
+        sample_negatives_per_row(
+            key, jnp.asarray(t.prob), jnp.asarray(t.alias),
+            jnp.arange(6, dtype=jnp.int32), (4, 3),
+        )
     )
     nmask = np.asarray(sgns.negative_mask(jnp.asarray(negs), jnp.asarray(contexts), jnp.asarray(mask)))
     exp0, exp1 = _numpy_oracle(syn0, syn1, centers, contexts, mask, negs, nmask, alpha)
