@@ -26,7 +26,11 @@ diagnostic JSON line. It always exits 0 with one JSON line on stdout.
 Environment knobs:
   BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_SPC (minibatches per device
   dispatch = scan length), BENCH_SHARED_NEG (pool size for the shared mode),
-  BENCH_MODES ("per_pair,shared" default), BENCH_DTYPE (float32|bfloat16),
+  BENCH_MODES (default "per_pair,per_pair_bf16c,shared_bf16c"; the "_bf16c"
+  suffix = bf16 MXU operands with f32 accumulation for the step's dense
+  contractions), BENCH_DTYPE (table dtype, default float32 to keep the
+  headline comparable across rounds; scripts/bench_sweep.py sweeps the
+  bfloat16 scale geometry),
   BENCH_PLATFORM (force a JAX platform), BENCH_ATTEMPT_TIMEOUT (seconds per
   worker attempt, default 600; the retry attempt is capped at 300),
   BENCH_MIN_SECONDS (timed-loop floor).
@@ -41,24 +45,25 @@ import time
 BASELINE_WORDS_PER_SEC_PER_CHIP = 50e6 / 32
 
 # Peak dense-matmul throughput by device kind, used only for the MFU
-# *estimate*. Values are the published bf16 peaks; float32 tables still do
-# their dot products through the MXU (via bf16x3-ish passes), so the MFU for
-# float32 runs is an underestimate against this peak — recorded as such.
-_PEAK_FLOPS = [
+# *estimate*. Values are the published bf16 peaks (the 394 TFLOPS
+# previously listed for v5e was the int8 peak — round-3 ADVICE.md). For
+# modes whose contractions run f32 operands, the MXU needs multiple bf16
+# passes; we charge those against bf16_peak/2 and record the assumption.
+_PEAK_FLOPS_BF16 = [
     ("v6", 918e12),
     ("v5p", 459e12),
-    ("v5", 394e12),  # v5e / "TPU v5 lite"
+    ("v5", 197e12),  # v5e / "TPU v5 lite"
     ("v4", 275e12),
     ("v3", 123e12),
     ("v2", 45e12),
 ]
 
 
-def _peak_for(device_kind: str):
+def _peak_for(device_kind: str, compute_dtype: str):
     dk = device_kind.lower()
-    for tag, peak in _PEAK_FLOPS:
+    for tag, peak in _PEAK_FLOPS_BF16:
         if tag in dk:
-            return peak
+            return peak if compute_dtype == "bfloat16" else peak / 2
     return None
 
 
@@ -71,8 +76,17 @@ def _config_from_env():
         "shared_negatives": int(os.environ.get("BENCH_SHARED_NEG", 4096)),
         "negatives": 5,
         "context_lanes": 7,
+        # Table dtype defaults to float32 so the per_pair headline stays
+        # directly comparable with BENCH_r03 (isolating the exchange
+        # rework); the bf16-table geometry is swept by
+        # scripts/bench_sweep.py, which sets BENCH_DTYPE explicitly.
         "dtype": os.environ.get("BENCH_DTYPE", "float32"),
-        "modes": os.environ.get("BENCH_MODES", "per_pair,shared"),
+        # Mode suffix "_bf16c" = bf16 MXU operands (f32 accumulation) for
+        # the step's dense contractions; no suffix = f32 operands (the
+        # exactness-tested numerics).
+        "modes": os.environ.get(
+            "BENCH_MODES", "per_pair,per_pair_bf16c,shared_bf16c"
+        ),
     }
 
 
@@ -86,7 +100,8 @@ def _flops_per_step(mode: str, cfg) -> float:
     2BCd+2BSd, d_pool 2BSd, outer+scatter 2BCd+Bd+Sd => ~6BCd + 6BSd.
     """
     B, C, d, n = cfg["batch"], cfg["context_lanes"], cfg["dim"], cfg["negatives"]
-    if mode == "per_pair":
+    estimator, _ = _mode_parts(mode)
+    if estimator == "per_pair":
         return 6.0 * B * C * d * (1 + n) + B * d
     S = cfg["shared_negatives"]
     return 6.0 * B * C * d + 6.0 * B * S * d + B * d + S * d
@@ -97,12 +112,20 @@ def _flops_per_step(mode: str, cfg) -> float:
 # ----------------------------------------------------------------------
 
 
+def _mode_parts(mode: str):
+    """Split a mode name into (estimator, compute_dtype)."""
+    if mode.endswith("_bf16c"):
+        return mode[: -len("_bf16c")], "bfloat16"
+    return mode, "float32"
+
+
 def _bench_mode(jax, mesh, cfg, mode: str, np):
     from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
 
     V, d, B = cfg["vocab"], cfg["dim"], cfg["batch"]
     spc, C, n = cfg["steps_per_call"], cfg["context_lanes"], cfg["negatives"]
-    shared = cfg["shared_negatives"] if mode == "shared" else 0
+    estimator, compute_dtype = _mode_parts(mode)
+    shared = cfg["shared_negatives"] if estimator == "shared" else 0
 
     # Zipf-ish counts: realistic index skew for gathers and the noise table.
     ranks = np.arange(1, V + 1, dtype=np.float64)
@@ -111,6 +134,7 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     eng = EmbeddingEngine(
         mesh, V, d, counts, num_negatives=n, seed=0,
         shared_negatives=shared, dtype=cfg["dtype"],
+        compute_dtype=compute_dtype,
     )
 
     rng = np.random.default_rng(0)
@@ -175,14 +199,21 @@ def worker_main() -> None:
     cfg = _config_from_env()
     dev = jax.devices()[0]
     mesh = make_mesh(1, 1, devices=[dev])
-    peak = _peak_for(dev.device_kind) if dev.platform == "tpu" else None
 
     modes = [m.strip() for m in cfg.pop("modes").split(",") if m.strip()]
     results = {}
+    peaks = {}
     for mode in modes:
+        _, compute_dtype = _mode_parts(mode)
+        peak = (
+            _peak_for(dev.device_kind, compute_dtype)
+            if dev.platform == "tpu" else None
+        )
         r = _bench_mode(jax, mesh, cfg, mode, np)
         if peak:
             r["mfu"] = round(r.pop("flops_per_sec") / peak, 4)
+            r["peak_flops_assumed"] = peak
+            peaks[mode] = peak
         else:
             r.pop("flops_per_sec")
         results[mode] = r
@@ -200,8 +231,8 @@ def worker_main() -> None:
         "config": cfg,
         "modes": results,
     }
-    if peak:
-        line["peak_flops_assumed"] = peak
+    if "per_pair" in peaks:
+        line["peak_flops_assumed"] = peaks["per_pair"]
         if "mfu" in headline:
             line["mfu"] = headline["mfu"]
     print(json.dumps(line))

@@ -116,6 +116,12 @@ class Word2Vec:
     def set_dtype(self, v: str) -> "Word2Vec":
         return self._set(dtype=v)
 
+    def set_compute_dtype(self, v: str) -> "Word2Vec":
+        """MXU operand dtype for the step's dense contractions ("float32"
+        default, "bfloat16" = MXU-native fast path; f32 accumulation
+        either way)."""
+        return self._set(compute_dtype=v)
+
     def set_steps_per_call(self, v: int) -> "Word2Vec":
         return self._set(steps_per_call=v)
 
@@ -538,6 +544,7 @@ class Word2Vec:
             seed=p.seed,
             dtype=p.dtype,
             shared_negatives=p.shared_negatives,
+            compute_dtype=p.compute_dtype,
         )
 
     def _train_batches(self, engine, batches, base_key, step0, alphas):

@@ -54,6 +54,7 @@ def sgns_grads(
     mask: jax.Array,  # (B, C) float32 — 1.0 where the context slot is real
     neg_mask: jax.Array,  # (B, C, n) float32 — negatives kept (see train step)
     alpha: jax.Array,  # () float32 learning rate
+    compute_dtype=jnp.float32,
 ) -> SgnsGrads:
     """Forward + backward of the SGNS objective for pre-gathered rows.
 
@@ -61,9 +62,22 @@ def sgns_grads(
         L = -log sigma(u_ctx . h) - sum_n log sigma(-u_neg . h)
     SGD coefficients (matching the reference's label-vs-sigmoid form at
     mllib:422-424): c_pos = alpha*(1 - sigma(f_pos)), c_neg = -alpha*sigma(f_neg).
+
+    ``compute_dtype=bfloat16`` feeds the d-contraction einsums bf16
+    operands with f32 accumulation (the MXU-native regime); coefficient
+    math, masking, and the loss stay f32. Word2vec SGD tolerates the
+    ~3-decimal-digit operand rounding (embeddings are trained with far
+    noisier estimators); the exactness-tested default stays f32.
     """
-    f_pos = jnp.einsum("bd,bcd->bc", h, u_pos)  # (B, C)
-    f_neg = jnp.einsum("bd,bcnd->bcn", h, u_neg)  # (B, C, n)
+    hc = h.astype(compute_dtype)
+    upc = u_pos.astype(compute_dtype)
+    unc = u_neg.astype(compute_dtype)
+    f_pos = jnp.einsum(
+        "bd,bcd->bc", hc, upc, preferred_element_type=jnp.float32
+    )  # (B, C)
+    f_neg = jnp.einsum(
+        "bd,bcnd->bcn", hc, unc, preferred_element_type=jnp.float32
+    )  # (B, C, n)
     s_pos = jax.nn.sigmoid(f_pos)
     s_neg = jax.nn.sigmoid(f_neg)
 
@@ -71,8 +85,12 @@ def sgns_grads(
     c_neg = -alpha * s_neg * neg_mask
 
     # d L/d h, with the learning rate folded in (pure SGD step direction).
-    d_center = jnp.einsum("bc,bcd->bd", c_pos, u_pos) + jnp.einsum(
-        "bcn,bcnd->bd", c_neg, u_neg
+    d_center = jnp.einsum(
+        "bc,bcd->bd", c_pos.astype(compute_dtype), upc,
+        preferred_element_type=jnp.float32,
+    ) + jnp.einsum(
+        "bcn,bcnd->bd", c_neg.astype(compute_dtype), unc,
+        preferred_element_type=jnp.float32,
     )
 
     # Monitoring loss (exact, masked mean over real pairs).
@@ -127,6 +145,7 @@ def shared_sgns_grads(
     #   skip applied pool-wide)
     alpha: jax.Array,  # () float32
     num_negatives: int,  # n — the per-pair draw count being emulated
+    compute_dtype=jnp.float32,
 ) -> SharedSgnsGrads:
     """SGNS gradients with one shared negative pool per step.
 
@@ -146,9 +165,20 @@ def shared_sgns_grads(
         d_center += c_pool @ u_pool    (B, d)  MXU
 
     so the only sparse traffic left is the centers and positive contexts.
+
+    ``compute_dtype=bfloat16`` runs the three dense matmuls with bf16
+    operands and f32 accumulation — the MXU-native regime (v5e bf16 peak
+    is ~2x its f32-via-passes rate); coefficients and loss stay f32.
     """
-    f_pos = jnp.einsum("bd,bcd->bc", h, u_pos)  # (B, C)
-    f_pool = h @ u_pool.T  # (B, S)
+    hc = h.astype(compute_dtype)
+    upool_c = u_pool.astype(compute_dtype)
+    f_pos = jnp.einsum(
+        "bd,bcd->bc", hc, u_pos.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )  # (B, C)
+    f_pool = jnp.dot(
+        hc, upool_c.T, preferred_element_type=jnp.float32
+    )  # (B, S)
     s_pos = jax.nn.sigmoid(f_pos)
     s_pool = jax.nn.sigmoid(f_pool)
 
@@ -160,8 +190,14 @@ def shared_sgns_grads(
     c_pos = alpha * (1.0 - s_pos) * mask
     c_pool = -alpha * s_pool * weight
 
-    d_center = jnp.einsum("bc,bcd->bd", c_pos, u_pos) + c_pool @ u_pool
-    d_pool = c_pool.T @ h  # (S, d)
+    cpool_c = c_pool.astype(compute_dtype)
+    d_center = jnp.einsum(
+        "bc,bcd->bd", c_pos.astype(compute_dtype),
+        u_pos.astype(compute_dtype), preferred_element_type=jnp.float32,
+    ) + jnp.dot(cpool_c, upool_c, preferred_element_type=jnp.float32)
+    d_pool = jnp.dot(
+        cpool_c.T, hc, preferred_element_type=jnp.float32
+    )  # (S, d)
 
     log_sig = jax.nn.log_sigmoid
     pos_loss = (-log_sig(f_pos) * mask).sum()

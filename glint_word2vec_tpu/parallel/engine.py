@@ -143,6 +143,7 @@ class EmbeddingEngine:
         extra_rows: int = 0,
         shared_negatives: int = 0,
         use_pallas: Optional[bool] = None,
+        compute_dtype: Optional[str] = None,
     ):
         """``extra_rows`` appends non-vocabulary rows to both tables (e.g.
         fastText char-ngram buckets, models/fasttext.py): they are trained
@@ -168,6 +169,17 @@ class EmbeddingEngine:
         self.unigram_power = float(unigram_power)
         self.unigram_table_size = unigram_table_size
         self._dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        # MXU operand dtype for the step's dense contractions (f32 accum
+        # either way). Default f32 = exactness-tested reference numerics;
+        # "bfloat16" is the MXU-native fast path (GLINT_W2V_MATMUL_DTYPE
+        # env overrides when the ctor arg is unset).
+        if compute_dtype is None:
+            compute_dtype = os.environ.get(
+                "GLINT_W2V_MATMUL_DTYPE", "float32"
+            )
+        self._compute_dtype = (
+            jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+        )
         # Pallas row kernels for the sparse table traffic: opt-in per
         # engine or via GLINT_W2V_PALLAS=1; interpret mode off-TPU so the
         # same flag is testable on the CPU mesh.
@@ -274,6 +286,7 @@ class EmbeddingEngine:
                 g = sgns.shared_sgns_grads(
                     h, u_pos, u_pool, mask, collide,
                     alpha.astype(jnp.float32), n,
+                    compute_dtype=self._compute_dtype,
                 )
                 # The pool update sums contributions from every data rank;
                 # after the psum it is identical everywhere, so each model
@@ -301,7 +314,8 @@ class EmbeddingEngine:
                 u_neg = u_neg.reshape(Bl, C, n, -1)
                 nmask = sgns.negative_mask(negs, contexts, mask)
                 g = sgns.sgns_grads(h, u_pos, u_neg, mask, nmask,
-                                    alpha.astype(jnp.float32))
+                                    alpha.astype(jnp.float32),
+                                    compute_dtype=self._compute_dtype)
 
                 ctx_g = lax.all_gather(contexts, DATA_AXIS, tiled=True)
                 negs_g = lax.all_gather(negs, DATA_AXIS, tiled=True)

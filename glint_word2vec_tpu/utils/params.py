@@ -92,6 +92,12 @@ class Word2VecParams:
     unigram_power: float = 0.75
     unigram_table_size: int | None = None
     dtype: str = "float32"
+    #: MXU operand dtype for the train step's dense contractions (f32
+    #: accumulation either way): "float32" = exactness-tested numerics,
+    #: "bfloat16" = the MXU-native fast path (ops/sgns.py). None defers to
+    #: the engine's GLINT_W2V_MATMUL_DTYPE env default (so the env knob
+    #: works through the model/CLI path too).
+    compute_dtype: str | None = None
     steps_per_call: int = 16
     shared_negatives: int = 0
 
@@ -116,6 +122,10 @@ class Word2VecParams:
             "unigram_table_size must be > 0 or None",
         )
         _require(self.dtype in ("float32", "bfloat16"), "dtype must be float32|bfloat16")
+        _require(
+            self.compute_dtype in (None, "float32", "bfloat16"),
+            "compute_dtype must be float32|bfloat16|None",
+        )
         _require(self.steps_per_call > 0, "steps_per_call must be > 0")
         _require(self.shared_negatives >= 0, "shared_negatives must be >= 0")
 

@@ -1,0 +1,179 @@
+"""External quality control: classic SGNS in pure NumPy.
+
+This is a genuinely independent implementation of skip-gram negative
+sampling — no imports from glint_word2vec_tpu, no shared gradient code, no
+JAX — in the style of the original word2vec C tool (the algorithm family
+the reference implements, README.md:10-15). It exists so QUALITY.json's
+baseline is not the framework grading itself (round-3 directive #5): if
+the framework's estimators and this ~100-line loop agree on analogy
+accuracy over the reference corpus, the quality claim stands on an
+external leg (the role gensim plays in the reference's ecosystem; gensim
+itself is not installable in this image).
+
+Conventions implemented (classic word2vec):
+  * vocab: lowercase tokens, min_count filter, frequency-rank indexing
+  * frequent-word subsampling, classic keep-probability
+    min(1, (sqrt(f/t) + 1) * t/f) at t=1e-3 (the C tool's default
+    ``sample``; without it this corpus's hub words collapse every vector
+    onto one frequency direction — measured top-1 0.03 vs 0.17 with)
+  * window: per-position shrunk b ~ U[0, window), symmetric context
+  * unigram^0.75 noise distribution, n draws per (center, context) pair
+  * update: center w predicts context c — train syn0[w] against syn1[c]
+    and negatives (the same orientation the framework trains)
+  * MAX_EXP-style logit clamp to [-6, 6] (the C tool's table range)
+  * linear LR anneal over all epochs to a 1e-4 floor
+
+Epochs default to 5: measured top-1 on the capital-of analogies is 0.17
+there, vs 0.03 at 2 epochs and a divergence-collapse at 10 (per-pair SGD
+on a 116k-word corpus is this brittle; the framework's batch-summed
+estimator is stable across all of these — that contrast is part of the
+control's value).
+
+Run:  python scripts/numpy_sgns_control.py [--corpus PATH]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+DEFAULT_CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+
+PAIRS = [
+    ("deutschland", "berlin"),
+    ("österreich", "wien"),
+    ("frankreich", "paris"),
+    ("spanien", "madrid"),
+    ("finnland", "helsinki"),
+    ("großbritannien", "london"),
+]
+
+
+def load_corpus(path, min_count=5):
+    sentences = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            toks = line.lower().split()
+            if toks:
+                sentences.append(toks)
+    counts = {}
+    for s in sentences:
+        for w in s:
+            counts[w] = counts.get(w, 0) + 1
+    kept = sorted(
+        ((w, c) for w, c in counts.items() if c >= min_count),
+        key=lambda wc: (-wc[1], wc[0]),
+    )
+    index = {w: i for i, (w, c) in enumerate(kept)}
+    cn = np.array([c for _, c in kept], dtype=np.float64)
+    sent_ids = [
+        np.array([index[w] for w in s if w in index], dtype=np.int32)
+        for s in sentences
+    ]
+    sent_ids = [s for s in sent_ids if len(s) > 1]
+    return index, cn, sent_ids
+
+
+def train(index, cn, sent_ids, dim=100, window=5, lr=0.025, epochs=5,
+          n=5, seed=1, sample=1e-3):
+    rng = np.random.default_rng(seed)
+    V = len(index)
+    syn0 = ((rng.random((V, dim)) - 0.5) / dim).astype(np.float32)
+    syn1 = np.zeros((V, dim), np.float32)
+    noise = cn**0.75
+    noise_cum = np.cumsum(noise / noise.sum())
+    if sample > 0:
+        frac = cn / cn.sum()
+        keep = np.minimum((np.sqrt(frac / sample) + 1) * (sample / frac), 1.0)
+    else:
+        keep = np.ones(len(cn))
+    total_words = sum(len(s) for s in sent_ids) * epochs
+    done = 0
+    for _ in range(epochs):
+        for sent in sent_ids:
+            alpha = max(lr * (1 - done / total_words), lr * 1e-4)
+            done += len(sent)
+            if sample > 0:
+                sent = sent[rng.random(len(sent)) < keep[sent]]
+            L = len(sent)
+            for i in range(L):
+                b = int(rng.integers(0, window))
+                lo, hi = max(0, i - window + b), min(L, i + window - b + 1)
+                w = sent[i]
+                for j in range(lo, hi):
+                    if j == i:
+                        continue
+                    c = sent[j]
+                    # n negatives for this pair from the unigram^0.75 table
+                    negs = np.searchsorted(
+                        noise_cum, rng.random(n)
+                    ).astype(np.int32)
+                    negs = negs[negs != c]
+                    tgt = np.concatenate(([c], negs))
+                    lbl = np.zeros(len(tgt), np.float32)
+                    lbl[0] = 1.0
+                    # copy: syn0[w] is a view, and the syn1 update below
+                    # must use the PRE-update center vector (C-tool order)
+                    h = syn0[w].copy()
+                    # MAX_EXP-style clamp of the C tool: outside [-6, 6]
+                    # the sigmoid saturates and the gradient is taken at
+                    # the boundary.
+                    f = np.clip(syn1[tgt] @ h, -6.0, 6.0)
+                    g = (lbl - 1.0 / (1.0 + np.exp(-f))) * alpha
+                    syn0[w] = h + g @ syn1[tgt]
+                    np.add.at(syn1, tgt, g[:, None] * h[None, :])
+    return syn0
+
+
+def evaluate(index, syn0, top_k):
+    """Accuracy on capital-of analogies, word2vec ranking convention:
+    expected word within top_k of b - a + c, query words excluded."""
+    norms = np.linalg.norm(syn0, axis=1)
+    unit = syn0 / np.where(norms > 0, norms, 1.0)[:, None]
+    correct = total = skipped = 0
+    for c1, k1 in PAIRS:
+        for c2, k2 in PAIRS:
+            if c1 == c2:
+                continue
+            try:
+                a, b, c, d = index[c1], index[k1], index[c2], index[k2]
+            except KeyError:
+                skipped += 1
+                continue
+            q = unit[b] - unit[a] + unit[c]
+            qn = np.linalg.norm(q)
+            scores = unit @ (q / qn if qn > 0 else q)
+            scores[[a, b, c]] = -np.inf
+            top = np.argpartition(-scores, top_k)[:top_k]
+            correct += int(d in top)
+            total += 1
+    return {"total": total, "correct": correct, "skipped_oov": skipped,
+            "accuracy": round(correct / max(total, 1), 4)}
+
+
+def run(corpus=DEFAULT_CORPUS, dim=100, epochs=5, seed=1, lr=0.025):
+    t0 = time.time()
+    index, cn, sent_ids = load_corpus(corpus)
+    syn0 = train(index, cn, sent_ids, dim=dim, epochs=epochs, seed=seed,
+                 lr=lr)
+    out = {
+        "implementation": "pure-numpy classic SGNS (scripts/numpy_sgns_control.py)",
+        "config": {"dim": dim, "window": 5, "lr": lr, "epochs": epochs,
+                   "negatives": 5, "seed": seed, "min_count": 5,
+                   "sample": 1e-3},
+        "vocab_size": len(index),
+        "train_seconds": round(time.time() - t0, 1),
+        "analogy_top1": evaluate(index, syn0, 1),
+        "analogy_top5": evaluate(index, syn0, 5),
+    }
+    return out
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument("--epochs", type=int, default=5)
+    args = ap.parse_args()
+    print(json.dumps(run(args.corpus, epochs=args.epochs), indent=2,
+                     ensure_ascii=False))

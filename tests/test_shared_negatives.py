@@ -132,3 +132,44 @@ def test_shared_mode_quality_gate(tiny_corpus):
             assert capital in hits, (country, capital, hits)
     finally:
         m.stop()
+
+
+def test_bf16_compute_dtype_close_to_f32():
+    # The MXU fast path (bf16 operands, f32 accumulation) must agree with
+    # the exactness-tested f32 path to bf16 operand precision — the same
+    # update directions, just ~3-decimal-digit rounding on the operands.
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.ops import sgns as S
+
+    rng = np.random.default_rng(5)
+    B, C, Sp, d, n = 8, 3, 16, 32, 4
+    h = jnp.asarray(rng.normal(0, 0.5, (B, d)).astype(np.float32))
+    u_pos = jnp.asarray(rng.normal(0, 0.5, (B, C, d)).astype(np.float32))
+    u_pool = jnp.asarray(rng.normal(0, 0.5, (Sp, d)).astype(np.float32))
+    mask = jnp.asarray((rng.random((B, C)) < 0.8).astype(np.float32))
+    collide = jnp.zeros((B, Sp), jnp.float32)
+    a = jnp.float32(0.05)
+
+    g32 = S.shared_sgns_grads(h, u_pos, u_pool, mask, collide, a, n)
+    g16 = S.shared_sgns_grads(
+        h, u_pos, u_pool, mask, collide, a, n, compute_dtype=jnp.bfloat16
+    )
+    np.testing.assert_allclose(
+        np.asarray(g16.d_pool), np.asarray(g32.d_pool), rtol=0.05, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(g16.d_center), np.asarray(g32.d_center), rtol=0.05,
+        atol=5e-4,
+    )
+
+    u_neg = jnp.asarray(rng.normal(0, 0.5, (B, C, n, d)).astype(np.float32))
+    nmask = jnp.asarray((rng.random((B, C, n)) < 0.9).astype(np.float32))
+    p32 = S.sgns_grads(h, u_pos, u_neg, mask, nmask, a)
+    p16 = S.sgns_grads(
+        h, u_pos, u_neg, mask, nmask, a, compute_dtype=jnp.bfloat16
+    )
+    np.testing.assert_allclose(
+        np.asarray(p16.d_center), np.asarray(p32.d_center), rtol=0.05,
+        atol=5e-4,
+    )
