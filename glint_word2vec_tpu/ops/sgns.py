@@ -32,6 +32,38 @@ import jax.numpy as jnp
 from glint_word2vec_tpu.ops.sampling import sample_negatives_per_row
 
 
+class SgnsCoefs(NamedTuple):
+    """Logit-stage outputs shared by every sharding layout: the scalar SGD
+    coefficients (the reference's gPlus/gMinus wire format, mllib:422-425)
+    plus the monitoring loss. Computed from FULL logits — under dim/column
+    sharding (parallel/engine.py layout="dims") each shard psums its
+    partial dot products first, then evaluates this identically."""
+
+    c_pos: jax.Array  # (B, C)
+    c_neg: jax.Array  # (B, C, n)
+    loss: jax.Array  # ()
+
+
+def sgns_coefs(
+    f_pos: jax.Array,  # (B, C) float32 FULL positive logits
+    f_neg: jax.Array,  # (B, C, n) float32 FULL negative logits
+    mask: jax.Array,  # (B, C) float32
+    neg_mask: jax.Array,  # (B, C, n) float32
+    alpha: jax.Array,  # () float32
+) -> SgnsCoefs:
+    """Coefficients + loss from already-reduced logits (layout-agnostic)."""
+    s_pos = jax.nn.sigmoid(f_pos)
+    s_neg = jax.nn.sigmoid(f_neg)
+    c_pos = alpha * (1.0 - s_pos) * mask
+    c_neg = -alpha * s_neg * neg_mask
+    log_sig = jax.nn.log_sigmoid
+    pair_loss = -log_sig(f_pos) * mask - jnp.sum(
+        log_sig(-f_neg) * neg_mask, axis=-1
+    ) * mask
+    loss = pair_loss.sum() / jnp.maximum(mask.sum(), 1.0)
+    return SgnsCoefs(c_pos=c_pos, c_neg=c_neg, loss=loss)
+
+
 class SgnsGrads(NamedTuple):
     """Scalar gradient coefficients + center-row gradient for one minibatch.
 
@@ -78,29 +110,30 @@ def sgns_grads(
     f_neg = jnp.einsum(
         "bd,bcnd->bcn", hc, unc, preferred_element_type=jnp.float32
     )  # (B, C, n)
-    s_pos = jax.nn.sigmoid(f_pos)
-    s_neg = jax.nn.sigmoid(f_neg)
-
-    c_pos = alpha * (1.0 - s_pos) * mask
-    c_neg = -alpha * s_neg * neg_mask
-
-    # d L/d h, with the learning rate folded in (pure SGD step direction).
-    d_center = jnp.einsum(
-        "bc,bcd->bd", c_pos.astype(compute_dtype), upc,
-        preferred_element_type=jnp.float32,
-    ) + jnp.einsum(
-        "bcn,bcnd->bd", c_neg.astype(compute_dtype), unc,
-        preferred_element_type=jnp.float32,
+    co = sgns_coefs(f_pos, f_neg, mask, neg_mask, alpha)
+    d_center = sgns_d_center(co.c_pos, co.c_neg, u_pos, u_neg, compute_dtype)
+    return SgnsGrads(
+        c_pos=co.c_pos, c_neg=co.c_neg, d_center=d_center, loss=co.loss
     )
 
-    # Monitoring loss (exact, masked mean over real pairs).
-    log_sig = jax.nn.log_sigmoid
-    pair_loss = -log_sig(f_pos) * mask - jnp.sum(
-        log_sig(-f_neg) * neg_mask, axis=-1
-    ) * mask
-    denom = jnp.maximum(mask.sum(), 1.0)
-    loss = pair_loss.sum() / denom
-    return SgnsGrads(c_pos=c_pos, c_neg=c_neg, d_center=d_center, loss=loss)
+
+def sgns_d_center(
+    c_pos: jax.Array,  # (B, C)
+    c_neg: jax.Array,  # (B, C, n)
+    u_pos: jax.Array,  # (B, C, dl) — full d or a column slice of it
+    u_neg: jax.Array,  # (B, C, n, dl)
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """d L/d h with the learning rate folded in (pure SGD step direction).
+    Columnwise-independent, so a dim-sharded shard passes its local column
+    slices and gets its local d_center slice — no communication."""
+    return jnp.einsum(
+        "bc,bcd->bd", c_pos.astype(compute_dtype),
+        u_pos.astype(compute_dtype), preferred_element_type=jnp.float32,
+    ) + jnp.einsum(
+        "bcn,bcnd->bd", c_neg.astype(compute_dtype),
+        u_neg.astype(compute_dtype), preferred_element_type=jnp.float32,
+    )
 
 
 def init_tables(
@@ -179,35 +212,69 @@ def shared_sgns_grads(
     f_pool = jnp.dot(
         hc, upool_c.T, preferred_element_type=jnp.float32
     )  # (B, S)
+    co = shared_sgns_coefs(f_pos, f_pool, mask, collide, alpha, num_negatives)
+    d_center, d_pool = shared_sgns_updates(
+        co.c_pos, co.c_pool, h, u_pos, u_pool, compute_dtype
+    )
+    return SharedSgnsGrads(
+        c_pos=co.c_pos, c_pool=co.c_pool, d_center=d_center, d_pool=d_pool,
+        loss=co.loss,
+    )
+
+
+class SharedSgnsCoefs(NamedTuple):
+    """Logit-stage outputs of the shared-pool estimator (layout-agnostic;
+    see :class:`SgnsCoefs`)."""
+
+    c_pos: jax.Array  # (B, C)
+    c_pool: jax.Array  # (B, S)
+    loss: jax.Array  # ()
+
+
+def shared_sgns_coefs(
+    f_pos: jax.Array,  # (B, C) float32 FULL positive logits
+    f_pool: jax.Array,  # (B, S) float32 FULL pool logits
+    mask: jax.Array,  # (B, C) float32
+    collide: jax.Array,  # (B, S) float32
+    alpha: jax.Array,  # () float32
+    num_negatives: int,
+) -> SharedSgnsCoefs:
+    """Coefficients + loss from already-reduced logits."""
     s_pos = jax.nn.sigmoid(f_pos)
     s_pool = jax.nn.sigmoid(f_pool)
-
     m_i = mask.sum(axis=1)  # (B,) real context count per center
-    S = u_pool.shape[0]
+    S = f_pool.shape[1]
     keep = 1.0 - collide
     weight = (m_i * (num_negatives / S))[:, None] * keep  # (B, S)
-
     c_pos = alpha * (1.0 - s_pos) * mask
     c_pool = -alpha * s_pool * weight
+    log_sig = jax.nn.log_sigmoid
+    pos_loss = (-log_sig(f_pos) * mask).sum()
+    pool_loss = (-log_sig(-f_pool) * weight).sum()
+    loss = (pos_loss + pool_loss) / jnp.maximum(mask.sum(), 1.0)
+    return SharedSgnsCoefs(c_pos=c_pos, c_pool=c_pool, loss=loss)
 
+
+def shared_sgns_updates(
+    c_pos: jax.Array,  # (B, C)
+    c_pool: jax.Array,  # (B, S)
+    h: jax.Array,  # (B, dl) — full d or a column slice
+    u_pos: jax.Array,  # (B, C, dl)
+    u_pool: jax.Array,  # (S, dl)
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """(d_center, d_pool) from coefficients — columnwise-independent, so a
+    dim-sharded shard passes local column slices (see :func:`sgns_d_center`)."""
     cpool_c = c_pool.astype(compute_dtype)
+    upool_c = u_pool.astype(compute_dtype)
     d_center = jnp.einsum(
         "bc,bcd->bd", c_pos.astype(compute_dtype),
         u_pos.astype(compute_dtype), preferred_element_type=jnp.float32,
     ) + jnp.dot(cpool_c, upool_c, preferred_element_type=jnp.float32)
     d_pool = jnp.dot(
-        cpool_c.T, hc, preferred_element_type=jnp.float32
-    )  # (S, d)
-
-    log_sig = jax.nn.log_sigmoid
-    pos_loss = (-log_sig(f_pos) * mask).sum()
-    pool_loss = (-log_sig(-f_pool) * weight).sum()
-    denom = jnp.maximum(mask.sum(), 1.0)
-    loss = (pos_loss + pool_loss) / denom
-    return SharedSgnsGrads(
-        c_pos=c_pos, c_pool=c_pool, d_center=d_center, d_pool=d_pool,
-        loss=loss,
-    )
+        cpool_c.T, h.astype(compute_dtype), preferred_element_type=jnp.float32
+    )  # (S, dl)
+    return d_center, d_pool
 
 
 def pool_collision_mask(

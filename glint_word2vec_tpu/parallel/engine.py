@@ -69,6 +69,7 @@ from glint_word2vec_tpu.parallel.mesh import (
     MODEL_AXIS,
     pad_to_multiple,
     table_sharding,
+    table_sharding_dims,
 )
 
 
@@ -144,14 +145,29 @@ class EmbeddingEngine:
         shared_negatives: int = 0,
         use_pallas: Optional[bool] = None,
         compute_dtype: Optional[str] = None,
+        layout: str = "rows",
     ):
         """``extra_rows`` appends non-vocabulary rows to both tables (e.g.
         fastText char-ngram buckets, models/fasttext.py): they are trained
         through subword center groups but are never negative-sampled (the
         noise table spans the vocab only) and never surface from the query
-        ops (top-k masks them; norms/multiply callers slice)."""
+        ops (top-k masks them; norms/multiply callers slice).
+
+        ``layout`` selects the model-axis partitioning:
+          * "rows" (default): vocab rows split 1/n per shard, full width.
+            Pulls psum whole rows over the model axis.
+          * "dims": every shard holds ALL rows x 1/n of the columns — the
+            CIKM'16 column partitioning the reference's servers use
+            (SURVEY.md §2.2): gathers/scatters are shard-local, and the
+            ONLY model-axis exchange in the train step is the psum of
+            scalar logit partials (the dot products the reference's
+            ``dotprod`` servers return). Per-chip HBM traffic for the
+            sparse row accesses divides by the model-axis size.
+        """
         if vocab_size <= 0 or dim <= 0:
             raise ValueError("vocab_size and dim must be > 0")
+        if layout not in ("rows", "dims"):
+            raise ValueError("layout must be 'rows' or 'dims'")
         if counts.shape != (vocab_size,):
             raise ValueError("counts must have shape (vocab_size,)")
         if extra_rows < 0:
@@ -190,8 +206,17 @@ class EmbeddingEngine:
             self._pallas_mode = 1 if jax.default_backend() == "tpu" else 2
         self.num_data = mesh.shape[DATA_AXIS]
         self.num_model = mesh.shape[MODEL_AXIS]
-        self.padded_vocab = pad_to_multiple(self.num_rows, self.num_model)
-        self.rows_per_shard = self.padded_vocab // self.num_model
+        self.layout = layout
+        if layout == "rows":
+            self.padded_vocab = pad_to_multiple(self.num_rows, self.num_model)
+            self.rows_per_shard = self.padded_vocab // self.num_model
+            self.padded_dim = self.dim
+            self.cols_per_shard = self.dim
+        else:  # dims
+            self.padded_vocab = self.num_rows  # no row padding needed
+            self.rows_per_shard = self.num_rows
+            self.padded_dim = pad_to_multiple(self.dim, self.num_model)
+            self.cols_per_shard = self.padded_dim // self.num_model
 
         # Noise distribution over the *unpadded* vocab — draws are therefore
         # identical for every mesh shape (padding never enters sampling),
@@ -206,20 +231,28 @@ class EmbeddingEngine:
 
         # Initialize tables directly sharded on-device (no host round-trip):
         # syn0 ~ U[-0.5/d, 0.5/d), syn1 = 0 (word2vec standard, ops/sgns.py).
-        # Randoms are drawn for the unpadded rows only, then zero-padded, so
-        # initial values are also mesh-shape-invariant.
-        tsh = table_sharding(mesh)
-        V, Vp, d = self.num_rows, self.padded_vocab, self.dim
+        # Randoms are drawn for the unpadded rows/cols only, then
+        # zero-padded, so initial values are layout- and mesh-shape-
+        # invariant (a "dims" engine starts bitwise-equal to a "rows" one).
+        tsh = self._table_sharding()
+        V, Vp, d, dp = self.num_rows, self.padded_vocab, self.dim, self.padded_dim
 
         def _init(key):
             s0, s1 = sgns.init_tables(key, V, d, self._dtype)
-            pad = ((0, Vp - V), (0, 0))
+            pad = ((0, Vp - V), (0, dp - d))
             return jnp.pad(s0, pad), jnp.pad(s1, pad)
 
         self.syn0, self.syn1 = jax.jit(_init, out_shardings=(tsh, tsh))(
             jax.random.PRNGKey(seed)
         )
         self._build_jitted_fns()
+
+    def _table_sharding(self):
+        return (
+            table_sharding(self.mesh)
+            if self.layout == "rows"
+            else table_sharding_dims(self.mesh)
+        )
 
     # ------------------------------------------------------------------
     # Jitted SPMD program construction
@@ -242,11 +275,14 @@ class EmbeddingEngine:
         Vs = self.rows_per_shard
         pm = self._pallas_mode
         n = self.num_negatives
-        tspec = P(MODEL_AXIS, None)
+        tspec = (
+            P(MODEL_AXIS, None) if self.layout == "rows"
+            else P(None, MODEL_AXIS)
+        )
         rep = P()
 
-        def step_body(syn0_l, syn1_l, prob, alias, centers, cmask,
-                      contexts, mask, key, alpha):
+        def step_body_rows(syn0_l, syn1_l, prob, alias, centers, cmask,
+                           contexts, mask, key, alpha):
             # Data-sharded inputs: centers/cmask (Bl, S), contexts/mask
             # (Bl, C). S = subword-group width; word-level training is the
             # S=1 specialization. The center representation is the masked
@@ -353,6 +389,134 @@ class EmbeddingEngine:
             )
             return syn0_l, syn1_l, loss
 
+        def step_body_dims(syn0_l, syn1_l, prob, alias, centers, cmask,
+                           contexts, mask, key, alpha):
+            # Column-sharded step (CIKM'16 partitioning, SURVEY.md §2.2):
+            # tables are (V, dl) local column slices with EVERY row
+            # resident, so gathers and scatter-adds are shard-local. The
+            # only model-axis communication is the psum of scalar logit
+            # partials — exactly the partial dot products the reference's
+            # servers return from ``dotprod``. The data-axis exchange is
+            # the same scalars+h contract as the rows layout, with h now
+            # a (B, dl) column slice (1/n the bytes per chip).
+            Bl, S = centers.shape
+            C = contexts.shape[1]
+            drank = lax.axis_index(DATA_AXIS)
+            cd = self._compute_dtype
+
+            h_rows = syn0_l[centers.reshape(-1)].astype(jnp.float32)
+            h_rows = h_rows.reshape(Bl, S, -1)
+            cnt = jnp.maximum(cmask.sum(axis=1, keepdims=True), 1.0)
+            h = (h_rows * cmask[..., None]).sum(axis=1) / cnt  # (Bl, dl)
+            u_pos = syn1_l[contexts.reshape(-1)].astype(jnp.float32)
+            u_pos = u_pos.reshape(Bl, C, -1)
+
+            h_g = lax.all_gather(h, DATA_AXIS, tiled=True)  # (B, dl)
+
+            if self.shared_negatives:
+                pool = sample_negatives(
+                    key, prob, alias, (self.shared_negatives,)
+                )
+                u_pool = syn1_l[pool].astype(jnp.float32)  # (S, dl)
+                collide = sgns.pool_collision_mask(pool, contexts, mask)
+                f_pos = lax.psum(
+                    jnp.einsum(
+                        "bd,bcd->bc", h.astype(cd), u_pos.astype(cd),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    MODEL_AXIS,
+                )
+                f_pool = lax.psum(
+                    jnp.dot(
+                        h.astype(cd), u_pool.astype(cd).T,
+                        preferred_element_type=jnp.float32,
+                    ),
+                    MODEL_AXIS,
+                )
+                co = sgns.shared_sgns_coefs(
+                    f_pos, f_pool, mask, collide,
+                    alpha.astype(jnp.float32), n,
+                )
+                d_center_l, d_pool_l = sgns.shared_sgns_updates(
+                    co.c_pos, co.c_pool, h, u_pos, u_pool, cd
+                )
+                d_pool_g = lax.psum(d_pool_l, DATA_AXIS)  # (S, dl)
+                ids1 = lax.all_gather(
+                    contexts.reshape(-1), DATA_AXIS, tiled=True
+                )
+                cpos_g = lax.all_gather(co.c_pos, DATA_AXIS, tiled=True)
+                d_upos = cpos_g[..., None] * h_g[:, None, :]
+                ids1_g = jnp.concatenate([ids1, pool])
+                upd1_g = jnp.concatenate(
+                    [d_upos.reshape(-1, d_upos.shape[-1]), d_pool_g]
+                )
+                loss_local = co.loss
+            else:
+                rows_g = drank * Bl + jnp.arange(Bl, dtype=jnp.int32)
+                negs = sample_negatives_per_row(
+                    key, prob, alias, rows_g, (C, n)
+                )
+                u_neg = syn1_l[negs.reshape(-1)].astype(jnp.float32)
+                u_neg = u_neg.reshape(Bl, C, n, -1)
+                nmask = sgns.negative_mask(negs, contexts, mask)
+                f_pos = lax.psum(
+                    jnp.einsum(
+                        "bd,bcd->bc", h.astype(cd), u_pos.astype(cd),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    MODEL_AXIS,
+                )
+                f_neg = lax.psum(
+                    jnp.einsum(
+                        "bd,bcnd->bcn", h.astype(cd), u_neg.astype(cd),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    MODEL_AXIS,
+                )
+                co = sgns.sgns_coefs(
+                    f_pos, f_neg, mask, nmask, alpha.astype(jnp.float32)
+                )
+                d_center_l = sgns.sgns_d_center(
+                    co.c_pos, co.c_neg, u_pos, u_neg, cd
+                )
+                ctx_g = lax.all_gather(contexts, DATA_AXIS, tiled=True)
+                negs_g = lax.all_gather(negs, DATA_AXIS, tiled=True)
+                cpos_g = lax.all_gather(co.c_pos, DATA_AXIS, tiled=True)
+                cneg_g = lax.all_gather(co.c_neg, DATA_AXIS, tiled=True)
+                dl = h_g.shape[-1]
+                d_upos = cpos_g[..., None] * h_g[:, None, :]
+                d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
+                ids1_g = jnp.concatenate(
+                    [ctx_g.reshape(-1), negs_g.reshape(-1)]
+                )
+                upd1_g = jnp.concatenate(
+                    [d_upos.reshape(-1, dl), d_uneg.reshape(-1, dl)]
+                )
+                loss_local = co.loss
+
+            dcen_g = lax.all_gather(d_center_l / cnt, DATA_AXIS, tiled=True)
+            cmask_g = lax.all_gather(cmask, DATA_AXIS, tiled=True)
+            ids0_g = lax.all_gather(
+                centers.reshape(-1), DATA_AXIS, tiled=True
+            )
+            upd0_g = (dcen_g[:, None, :] * cmask_g[..., None]).reshape(
+                -1, dcen_g.shape[-1]
+            )
+            # Every row is local: plain scatter-adds, no ownership masks.
+            syn0_l = syn0_l.at[ids0_g].add(upd0_g.astype(syn0_l.dtype))
+            syn1_l = syn1_l.at[ids1_g].add(upd1_g.astype(syn1_l.dtype))
+
+            denom = mask.sum()
+            loss_sum = loss_local * jnp.maximum(denom, 1.0)
+            loss = lax.psum(loss_sum, DATA_AXIS) / jnp.maximum(
+                lax.psum(denom, DATA_AXIS), 1.0
+            )
+            return syn0_l, syn1_l, loss
+
+        step_body = (
+            step_body_rows if self.layout == "rows" else step_body_dims
+        )
+
         self._train_step = jax.jit(
             self._shard_map(
                 step_body,
@@ -404,7 +568,17 @@ class EmbeddingEngine:
             donate_argnums=(0, 1),
         )
 
+        dims = self.layout == "dims"
+        dcols = self.cols_per_shard
+        dim_real = self.dim
+
         def local_pull(table_l, idx):
+            if dims:
+                rows = table_l[idx].astype(jnp.float32)  # (L, dl)
+                full = lax.all_gather(
+                    rows, MODEL_AXIS, tiled=True, axis=1
+                )  # (L, padded_dim)
+                return full[:, :dim_real]
             start = lax.axis_index(MODEL_AXIS) * Vs
             return _pull_rows(table_l, idx, start, Vs, pm)
 
@@ -415,6 +589,14 @@ class EmbeddingEngine:
         def local_pull_average(table_l, idx, m):
             # idx/m: (S, L) padded sentence word-indices + validity mask.
             S, L = idx.shape
+            if dims:
+                rows = table_l[idx.reshape(-1)].astype(jnp.float32)
+                rows = rows.reshape(S, L, -1) * m[..., None]
+                mean_l = rows.sum(axis=1) / jnp.maximum(
+                    m.sum(axis=1)[:, None], 1.0
+                )  # (S, dl): the server-side partial mean
+                full = lax.all_gather(mean_l, MODEL_AXIS, tiled=True, axis=1)
+                return full[:, :dim_real]
             start = lax.axis_index(MODEL_AXIS) * Vs
             rows = _pull_rows(table_l, idx.reshape(-1), start, Vs, pm)
             rows = rows.reshape(S, L, -1) * m[..., None]
@@ -429,28 +611,68 @@ class EmbeddingEngine:
         )
 
         def local_norms(table_l):
+            if dims:
+                # Partial sum of squares over local columns, reduced over
+                # the model axis; output replicated.
+                sq = (table_l.astype(jnp.float32) ** 2).sum(axis=1)
+                return jnp.sqrt(lax.psum(sq, MODEL_AXIS))
             # Shard-local, no communication: output stays model-sharded.
             return jnp.sqrt(
                 (table_l.astype(jnp.float32) ** 2).sum(axis=1)
             )
 
         self._norms = jax.jit(
-            self._shard_map(local_norms, in_specs=(tspec,), out_specs=P(MODEL_AXIS))
+            self._shard_map(
+                local_norms, in_specs=(tspec,),
+                out_specs=rep if dims else P(MODEL_AXIS),
+            )
         )
 
+        def _local_cols(v):
+            # Slice the replicated padded query vector down to this
+            # shard's column block.
+            mrank = lax.axis_index(MODEL_AXIS)
+            return lax.dynamic_slice_in_dim(v, mrank * dcols, dcols)
+
         def local_multiply(table_l, v):
+            if dims:
+                # Partial dot over local columns -> psum: exactly the
+                # reference servers' partial-dot-product contract.
+                return lax.psum(
+                    table_l.astype(jnp.float32) @ _local_cols(v), MODEL_AXIS
+                )
             # Distributed matvec: each shard scores its own rows (the TP
             # matvec noted in SURVEY.md §2.3); output model-sharded.
             return table_l.astype(jnp.float32) @ v
 
         self._multiply = jax.jit(
             self._shard_map(
-                local_multiply, in_specs=(tspec, rep), out_specs=P(MODEL_AXIS)
+                local_multiply, in_specs=(tspec, rep),
+                out_specs=rep if dims else P(MODEL_AXIS),
             )
         )
 
+        norms_spec = rep if dims else P(MODEL_AXIS)
+
         def make_topk(k: int):
             def local_topk(table_l, v, norms_l):
+                if dims:
+                    # Partial scores over local columns, psum'd to full
+                    # cosine scores (replicated), then ranked. The psum
+                    # moves V floats of scalars — never rows.
+                    scores = lax.psum(
+                        table_l.astype(jnp.float32) @ _local_cols(v),
+                        MODEL_AXIS,
+                    )  # (V,)
+                    safe = jnp.where(norms_l > 0, norms_l, 1.0)
+                    is_word = (
+                        jnp.arange(scores.shape[0]) < self.vocab_size
+                    )
+                    cos = jnp.where(
+                        (norms_l > 0) & is_word, scores / safe, -jnp.inf
+                    )
+                    val, idx = lax.top_k(cos, min(k, scores.shape[0]))
+                    return val, idx
                 # Cosine top-k without materializing all V scores on one
                 # device: local top-k per shard, all_gather the M*k
                 # candidates, merge. Replaces the reference's full-vocab
@@ -477,13 +699,33 @@ class EmbeddingEngine:
             return jax.jit(
                 self._shard_map(
                     local_topk,
-                    in_specs=(tspec, rep, P(MODEL_AXIS)),
+                    in_specs=(tspec, rep, norms_spec),
                     out_specs=(rep, rep),
                 )
             )
 
         def make_topk_batch(k: int):
             def local_topk_batch(table_l, q, norms_l):
+                if dims:
+                    # q arrives padded to (Q, padded_dim); each shard
+                    # scores its column block, psum -> full scores. The
+                    # public method chunks Q so (Q, V) stays bounded.
+                    mrank = lax.axis_index(MODEL_AXIS)
+                    q_l = lax.dynamic_slice_in_dim(
+                        q, mrank * dcols, dcols, axis=1
+                    )
+                    scores = lax.psum(
+                        q_l @ table_l.astype(jnp.float32).T, MODEL_AXIS
+                    )  # (Q, V)
+                    safe = jnp.where(norms_l > 0, norms_l, 1.0)
+                    is_word = (
+                        jnp.arange(scores.shape[1]) < self.vocab_size
+                    )
+                    cos = jnp.where(
+                        (norms_l > 0) & is_word, scores / safe, -jnp.inf
+                    )
+                    val, idx = lax.top_k(cos, min(k, scores.shape[1]))
+                    return val, idx
                 # q: (Q, d) replicated query batch. Same candidate-merge
                 # scheme as the single-vector kernel, vectorized over Q —
                 # one MXU matmul scores all queries against this shard.
@@ -510,7 +752,7 @@ class EmbeddingEngine:
             return jax.jit(
                 self._shard_map(
                     local_topk_batch,
-                    in_specs=(tspec, rep, P(MODEL_AXIS)),
+                    in_specs=(tspec, rep, norms_spec),
                     out_specs=(rep, rep),
                 )
             )
@@ -668,9 +910,12 @@ class EmbeddingEngine:
                 lambda table, block, s: jax.lax.dynamic_update_slice(
                     table, block.astype(table.dtype), (s, 0)
                 ),
-                out_shardings=table_sharding(self.mesh),
+                out_shardings=self._table_sharding(),
                 donate_argnums=(0,),
             )
+        pad = self.padded_dim - self.dim
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
         self.syn0 = self._write_rows_fn(
             self.syn0, rows, jnp.int32(start_row)
         )
@@ -687,12 +932,21 @@ class EmbeddingEngine:
             self._norms_cache = self._norms(self.syn0)
         return self._norms_cache
 
+    def _pad_query(self, v: np.ndarray) -> jnp.ndarray:
+        """Pad a (d,) or (Q, d) query to padded_dim for the dims layout
+        (zero columns contribute zero to every partial dot product)."""
+        pad = self.padded_dim - self.dim
+        if pad:
+            widths = [(0, 0)] * (v.ndim - 1) + [(0, pad)]
+            v = np.pad(v, widths)
+        return jnp.asarray(v)
+
     def multiply(self, vec) -> jax.Array:
         """Distributed matvec syn0 @ vec (Glint ``multiply``, mllib:598)."""
-        v = jnp.asarray(vec, dtype=jnp.float32)
+        v = np.asarray(vec, dtype=np.float32)
         if v.shape != (self.dim,):
             raise ValueError(f"vec must have shape ({self.dim},)")
-        return self._multiply(self.syn0, v)
+        return self._multiply(self.syn0, self._pad_query(v))
 
     def top_k_cosine(self, vec, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """On-device distributed top-k by cosine similarity against syn0.
@@ -709,7 +963,7 @@ class EmbeddingEngine:
         if k not in self._topk_cache:
             self._topk_cache[k] = self._make_topk(k)
         val, idx = self._topk_cache[k](
-            self.syn0, jnp.asarray(v), self.norms()
+            self.syn0, self._pad_query(v), self.norms()
         )
         return np.asarray(val), np.asarray(idx)
 
@@ -727,12 +981,27 @@ class EmbeddingEngine:
             raise ValueError(f"vecs must have shape (Q, {self.dim})")
         nrm = np.linalg.norm(q, axis=1, keepdims=True)
         q = q / np.where(nrm > 0, nrm, 1.0)
+        kk = min(k, self.padded_vocab)
+        if q.shape[0] == 0:
+            empty = np.zeros((0, kk))
+            return empty.astype(np.float32), empty.astype(np.int64)
         if k not in self._topk_batch_cache:
             self._topk_batch_cache[k] = self._make_topk_batch(k)
-        val, idx = self._topk_batch_cache[k](
-            self.syn0, jnp.asarray(q), self.norms()
-        )
-        return np.asarray(val), np.asarray(idx)
+        # Dims layout materializes full (Q, V) scores per shard; chunk Q
+        # to a ~256 MB score-matrix budget so the intermediate stays
+        # bounded at any vocab size (10M rows -> 6-query chunks).
+        if self.layout == "dims":
+            chunk = max(1, int(256e6 // (4 * self.padded_vocab)))
+        else:
+            chunk = q.shape[0]
+        vals, idxs = [], []
+        for s in range(0, q.shape[0], chunk):
+            val, idx = self._topk_batch_cache[k](
+                self.syn0, self._pad_query(q[s : s + chunk]), self.norms()
+            )
+            vals.append(np.asarray(val))
+            idxs.append(np.asarray(idx))
+        return np.concatenate(vals), np.concatenate(idxs)
 
     # ------------------------------------------------------------------
     # Persistence / lifecycle
@@ -755,36 +1024,53 @@ class EmbeddingEngine:
         if mode == "sharded":
             # The manifest is deterministic from mesh geometry (identical on
             # every process); files are written only by a process that can
-            # address the block, each block by exactly one process.
+            # address the block, each block by exactly one process. Blocks
+            # are row ranges under the rows layout and column ranges under
+            # the dims layout ("axis" in each manifest entry; absent =
+            # rows, for round-2 checkpoints).
+            axis = "rows" if self.layout == "rows" else "cols"
+            per_shard = (
+                self.rows_per_shard if axis == "rows" else self.cols_per_shard
+            )
+            real_extent = self.num_rows if axis == "rows" else self.dim
             for name, table in (("syn0", self.syn0), ("syn1", self.syn1)):
                 for k in range(self.num_model):
-                    start = k * self.rows_per_shard
-                    stop = min(start + self.rows_per_shard, self.num_rows)
+                    start = k * per_shard
+                    stop = min(start + per_shard, real_extent)
                     if start >= stop:
                         continue  # pure-padding block
-                    fname = f"{name}.r{start:012d}.npy"
+                    fname = f"{name}.{axis[0]}{start:012d}.npy"
                     shard_files[name].append(
-                        {"file": fname, "start": start, "stop": stop}
+                        {"file": fname, "start": start, "stop": stop,
+                         "axis": axis}
                     )
+                ix = 0 if axis == "rows" else 1
                 for shard in table.addressable_shards:
                     if shard.replica_id != 0:
                         continue  # replica 0 of each block writes, once
-                    start = shard.index[0].start or 0
-                    if start >= self.num_rows:
+                    start = shard.index[ix].start or 0
+                    if start >= real_extent:
                         continue
-                    stop = min(start + self.rows_per_shard, self.num_rows)
-                    block = np.asarray(shard.data, dtype=np.float32)[
-                        : stop - start
-                    ]
+                    stop = min(start + per_shard, real_extent)
+                    data = np.asarray(shard.data, dtype=np.float32)
+                    if axis == "rows":
+                        block = data[: stop - start]
+                    else:
+                        block = data[: self.num_rows, : stop - start]
                     np.save(
-                        os.path.join(path, f"{name}.r{start:012d}.npy"), block
+                        os.path.join(path, f"{name}.{axis[0]}{start:012d}.npy"),
+                        block,
                     )
         else:
             if mode != "single":
                 raise ValueError("mode must be 'sharded' or 'single'")
             if jax.process_index() == 0:
-                syn0 = np.asarray(self.syn0, dtype=np.float32)[: self.num_rows]
-                syn1 = np.asarray(self.syn1, dtype=np.float32)[: self.num_rows]
+                syn0 = np.asarray(self.syn0, dtype=np.float32)[
+                    : self.num_rows, : self.dim
+                ]
+                syn1 = np.asarray(self.syn1, dtype=np.float32)[
+                    : self.num_rows, : self.dim
+                ]
                 np.save(os.path.join(path, "syn0.npy"), syn0)
                 np.save(os.path.join(path, "syn1.npy"), syn1)
         if jax.process_index() == 0:
@@ -792,6 +1078,7 @@ class EmbeddingEngine:
             np.save(os.path.join(path, "counts.npy"), counts)
         meta = {
             "format": mode,
+            "layout": self.layout,
             "vocab_size": self.vocab_size,
             "dim": self.dim,
             "num_negatives": self.num_negatives,
@@ -828,6 +1115,7 @@ class EmbeddingEngine:
             meta["vocab_size"],
             meta["dim"],
             counts,
+            layout=overrides.get("layout", meta.get("layout", "rows")),
             num_negatives=overrides.get("num_negatives", meta["num_negatives"]),
             unigram_power=overrides.get(
                 "unigram_power", meta.get("unigram_power", 0.75)
@@ -862,31 +1150,49 @@ class EmbeddingEngine:
                 f"extra={self.num_rows - self.vocab_size}, d={self.dim})"
             )
         fmt = meta.get("format", "single")
-        tsh = table_sharding(self.mesh)
+        tsh = self._table_sharding()
         for name in ("syn0", "syn1"):
+            # Source blocks as (row range, col range, data), covering any
+            # mix of row-block (rows layout), col-block (dims layout), or
+            # whole-table files — so checkpoints re-home across BOTH mesh
+            # shapes and layouts.
             if fmt == "sharded":
-                blocks = [
-                    (
-                        b["start"],
-                        b["stop"],
-                        np.load(os.path.join(path, b["file"]), mmap_mode="r"),
+                blocks = []
+                for b in meta["shards"][name]:
+                    data = np.load(
+                        os.path.join(path, b["file"]), mmap_mode="r"
                     )
-                    for b in meta["shards"][name]
-                ]
+                    if b.get("axis", "rows") == "rows":
+                        blocks.append(
+                            ((b["start"], b["stop"]), (0, data.shape[1]), data)
+                        )
+                    else:
+                        blocks.append(
+                            ((0, data.shape[0]), (b["start"], b["stop"]), data)
+                        )
             else:
                 arr = np.load(os.path.join(path, f"{name}.npy"), mmap_mode="r")
-                blocks = [(0, arr.shape[0], arr)]
+                blocks = [((0, arr.shape[0]), (0, arr.shape[1]), arr)]
 
             def assemble(index, _blocks=blocks):
-                row_sl = index[0]
-                start = row_sl.start or 0
-                stop = row_sl.stop if row_sl.stop is not None else self.padded_vocab
-                out = np.zeros((stop - start, self.dim), np.float32)
-                for bstart, bstop, data in _blocks:
-                    lo, hi = max(start, bstart), min(stop, bstop)
-                    if lo < hi:
-                        out[lo - start : hi - start] = data[
-                            lo - bstart : hi - bstart
+                row_sl, col_sl = index[0], index[1]
+                r0 = row_sl.start or 0
+                r1 = (
+                    row_sl.stop if row_sl.stop is not None
+                    else self.padded_vocab
+                )
+                c0 = col_sl.start or 0
+                c1 = (
+                    col_sl.stop if col_sl.stop is not None
+                    else self.padded_dim
+                )
+                out = np.zeros((r1 - r0, c1 - c0), np.float32)
+                for (br0, br1), (bc0, bc1), data in _blocks:
+                    rlo, rhi = max(r0, br0), min(r1, br1)
+                    clo, chi = max(c0, bc0), min(c1, bc1)
+                    if rlo < rhi and clo < chi:
+                        out[rlo - r0 : rhi - r0, clo - c0 : chi - c0] = data[
+                            rlo - br0 : rhi - br0, clo - bc0 : chi - bc0
                         ]
                 return out.astype(self._dtype)
 
@@ -894,7 +1200,7 @@ class EmbeddingEngine:
                 self,
                 name,
                 jax.make_array_from_callback(
-                    (self.padded_vocab, self.dim), tsh, assemble
+                    (self.padded_vocab, self.padded_dim), tsh, assemble
                 ),
             )
         self._norms_cache = None
@@ -906,10 +1212,13 @@ class EmbeddingEngine:
             raise ValueError("syn0 shape mismatch")
         if syn1.shape != (self.num_rows, self.dim):
             raise ValueError("syn1 shape mismatch")
-        pad = self.padded_vocab - self.num_rows
-        tsh = table_sharding(self.mesh)
-        full0 = np.pad(syn0, ((0, pad), (0, 0))).astype(np.float32)
-        full1 = np.pad(syn1, ((0, pad), (0, 0))).astype(np.float32)
+        pad = (
+            (0, self.padded_vocab - self.num_rows),
+            (0, self.padded_dim - self.dim),
+        )
+        tsh = self._table_sharding()
+        full0 = np.pad(syn0, pad).astype(np.float32)
+        full1 = np.pad(syn1, pad).astype(np.float32)
         self.syn0 = jax.device_put(jnp.asarray(full0, dtype=self._dtype), tsh)
         self.syn1 = jax.device_put(jnp.asarray(full1, dtype=self._dtype), tsh)
         self._norms_cache = None

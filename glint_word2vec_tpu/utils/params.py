@@ -98,6 +98,11 @@ class Word2VecParams:
     #: the engine's GLINT_W2V_MATMUL_DTYPE env default (so the env knob
     #: works through the model/CLI path too).
     compute_dtype: str | None = None
+    #: Model-axis table partitioning: "rows" (vocab rows split 1/n) or
+    #: "dims" (every shard holds all rows x 1/n of the columns — the
+    #: CIKM'16 column partitioning; model-axis traffic becomes scalar
+    #: logit partials). See parallel/engine.py.
+    layout: str = "rows"
     steps_per_call: int = 16
     shared_negatives: int = 0
 
@@ -125,6 +130,9 @@ class Word2VecParams:
         _require(
             self.compute_dtype in (None, "float32", "bfloat16"),
             "compute_dtype must be float32|bfloat16|None",
+        )
+        _require(
+            self.layout in ("rows", "dims"), "layout must be rows|dims"
         )
         _require(self.steps_per_call > 0, "steps_per_call must be > 0")
         _require(self.shared_negatives >= 0, "shared_negatives must be >= 0")
