@@ -41,6 +41,13 @@ def _add_train(sub):
     p.add_argument("--num-shards", type=int, default=1,
                    help="model-parallel mesh axis (reference numParameterServers)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
+                   default=None,
+                   help="MXU operand dtype for the step's dense "
+                        "contractions (f32 accumulation either way)")
+    p.add_argument("--layout", choices=["rows", "dims"], default="rows",
+                   help="model-axis table partitioning: vocab rows or "
+                        "embedding dims (CIKM column sharding)")
     p.add_argument("--steps-per-call", type=int, default=16,
                    help="minibatches per device dispatch (on-device scan)")
     p.add_argument("--shared-negatives", type=int, default=0,
@@ -142,6 +149,8 @@ def _run(args) -> int:
             num_partitions=args.num_partitions,
             num_shards=args.num_shards,
             dtype=args.dtype,
+            compute_dtype=args.compute_dtype,
+            layout=args.layout,
             steps_per_call=args.steps_per_call,
             shared_negatives=args.shared_negatives,
         )

@@ -88,6 +88,8 @@ class FastTextWord2Vec(Word2Vec):
             dtype=p.dtype,
             extra_rows=p.bucket,
             shared_negatives=p.shared_negatives,
+            compute_dtype=p.compute_dtype,
+            layout=p.layout,
         )
 
     def _train_batches(self, engine, batches, base_key, step0, alphas):

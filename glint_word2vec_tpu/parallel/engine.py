@@ -114,6 +114,25 @@ def _scatter_rows(table_l, idx, upd, start, rows_per_shard, pallas_mode=0):
     return table_l.at[clipped].add(upd.astype(table_l.dtype))
 
 
+#: VMEM budget for pinning h_g whole in the fused rank-1 scatter kernel
+#: (ops/pallas_rows.scatter_add_rank1): ~16 MB/core minus block buffers.
+_RANK1_FUSE_VMEM_BYTES = 10_000_000
+
+
+def _rank1_payload(cpos_g, cneg_g, C: int, n: int):
+    """(coefs, hidx) for the fused rank-1 scatter, matching the update
+    ordering ids1_g = [contexts.flat | negs.flat] (rank-major batch axis).
+    Shared by both layouts' step bodies — the ordering contract lives in
+    exactly one place."""
+    B = cpos_g.shape[0]
+    coefs = jnp.concatenate([cpos_g.reshape(-1), cneg_g.reshape(-1)])
+    hidx = jnp.concatenate([
+        jnp.repeat(jnp.arange(B, dtype=jnp.int32), C),
+        jnp.repeat(jnp.arange(B, dtype=jnp.int32), C * n),
+    ])
+    return coefs, hidx
+
+
 class EmbeddingEngine:
     """Owns the sharded syn0/syn1 tables and all device-side ops.
 
@@ -357,17 +376,44 @@ class EmbeddingEngine:
                 negs_g = lax.all_gather(negs, DATA_AXIS, tiled=True)
                 cpos_g = lax.all_gather(g.c_pos, DATA_AXIS, tiled=True)
                 cneg_g = lax.all_gather(g.c_neg, DATA_AXIS, tiled=True)
-                # Consumer-side outer products (coef x h), rank-major along
-                # the batch axis on every operand, so ids and updates align.
-                d = h_g.shape[-1]
-                d_upos = cpos_g[..., None] * h_g[:, None, :]
-                d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
                 ids1_g = jnp.concatenate(
                     [ctx_g.reshape(-1), negs_g.reshape(-1)]
                 )
-                upd1_g = jnp.concatenate(
-                    [d_upos.reshape(-1, d), d_uneg.reshape(-1, d)]
+                fuse = pm and (
+                    h_g.shape[0] * h_g.shape[1] * 4
+                    <= _RANK1_FUSE_VMEM_BYTES
                 )
+                if fuse:
+                    # Fused-payload Pallas scatter: the (N, d) rank-1
+                    # updates are formed in VMEM inside the kernel
+                    # (ops/pallas_rows.scatter_add_rank1); h_g is pinned
+                    # whole in VMEM (gated on fitting the budget above —
+                    # larger shapes fall back to the dense path).
+                    # Ownership masking = zeroed coefs + clipped ids, as
+                    # in _scatter_rows.
+                    from glint_word2vec_tpu.ops.pallas_rows import (
+                        scatter_add_rank1,
+                    )
+
+                    coefs, hidx = _rank1_payload(cpos_g, cneg_g, C, n)
+                    loc = ids1_g - start
+                    own = (loc >= 0) & (loc < Vs)
+                    coefs = jnp.where(own, coefs, 0.0)
+                    clipped = jnp.clip(loc, 0, Vs - 1)
+                    syn1_l = scatter_add_rank1(
+                        syn1_l, clipped, coefs, h_g, hidx,
+                        interpret=pm == 2,
+                    )
+                    upd1_g = None
+                else:
+                    # Consumer-side outer products (coef x h), rank-major
+                    # along the batch axis, so ids and updates align.
+                    d = h_g.shape[-1]
+                    d_upos = cpos_g[..., None] * h_g[:, None, :]
+                    d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
+                    upd1_g = jnp.concatenate(
+                        [d_upos.reshape(-1, d), d_uneg.reshape(-1, d)]
+                    )
 
             # The center gradient is distributed over the group's rows
             # (d mean / d row = 1/count): ship the (Bl, d) gradient + the
@@ -379,7 +425,8 @@ class EmbeddingEngine:
                 -1, dcen_g.shape[-1]
             )
             syn0_l = _scatter_rows(syn0_l, ids0_g, upd0_g, start, Vs, pm)
-            syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs, pm)
+            if upd1_g is not None:
+                syn1_l = _scatter_rows(syn1_l, ids1_g, upd1_g, start, Vs, pm)
 
             # Masked-mean loss over the global batch.
             denom = mask.sum()
@@ -483,15 +530,34 @@ class EmbeddingEngine:
                 negs_g = lax.all_gather(negs, DATA_AXIS, tiled=True)
                 cpos_g = lax.all_gather(co.c_pos, DATA_AXIS, tiled=True)
                 cneg_g = lax.all_gather(co.c_neg, DATA_AXIS, tiled=True)
-                dl = h_g.shape[-1]
-                d_upos = cpos_g[..., None] * h_g[:, None, :]
-                d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
                 ids1_g = jnp.concatenate(
                     [ctx_g.reshape(-1), negs_g.reshape(-1)]
                 )
-                upd1_g = jnp.concatenate(
-                    [d_upos.reshape(-1, dl), d_uneg.reshape(-1, dl)]
+                fuse = pm and (
+                    h_g.shape[0] * h_g.shape[1] * 4
+                    <= _RANK1_FUSE_VMEM_BYTES
                 )
+                if fuse:
+                    # Fused-payload Pallas scatter (no ownership mask
+                    # needed: every row is local under the dims layout;
+                    # same VMEM-fit gate as the rows layout).
+                    from glint_word2vec_tpu.ops.pallas_rows import (
+                        scatter_add_rank1,
+                    )
+
+                    coefs, hidx = _rank1_payload(cpos_g, cneg_g, C, n)
+                    syn1_l = scatter_add_rank1(
+                        syn1_l, ids1_g, coefs, h_g, hidx,
+                        interpret=pm == 2,
+                    )
+                    upd1_g = None
+                else:
+                    dl = h_g.shape[-1]
+                    d_upos = cpos_g[..., None] * h_g[:, None, :]
+                    d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
+                    upd1_g = jnp.concatenate(
+                        [d_upos.reshape(-1, dl), d_uneg.reshape(-1, dl)]
+                    )
                 loss_local = co.loss
 
             dcen_g = lax.all_gather(d_center_l / cnt, DATA_AXIS, tiled=True)
@@ -504,7 +570,8 @@ class EmbeddingEngine:
             )
             # Every row is local: plain scatter-adds, no ownership masks.
             syn0_l = syn0_l.at[ids0_g].add(upd0_g.astype(syn0_l.dtype))
-            syn1_l = syn1_l.at[ids1_g].add(upd1_g.astype(syn1_l.dtype))
+            if upd1_g is not None:
+                syn1_l = syn1_l.at[ids1_g].add(upd1_g.astype(syn1_l.dtype))
 
             denom = mask.sum()
             loss_sum = loss_local * jnp.maximum(denom, 1.0)

@@ -77,6 +77,29 @@ def main() -> None:
             1,
         )
 
+    # Fused rank-1 scatter (scatter_add_rank1): coef x h formed in VMEM vs
+    # the XLA outer-product + scatter it replaces in the engine's pm path.
+    from glint_word2vec_tpu.ops.pallas_rows import scatter_add_rank1
+
+    B_h = min(8192, N)
+    coef = jnp.asarray(rng.normal(size=N).astype(np.float32) * 1e-3)
+    h = jnp.asarray(rng.normal(size=(B_h, d)).astype(np.float32))
+    hidx = jnp.asarray(rng.integers(0, B_h, N).astype(np.int32))
+    xla_rank1 = jax.jit(
+        lambda t, i, c, hh, x: t.at[i].add(c[:, None] * hh[x])
+    )
+    results["scatter_rank1_xla_us"] = round(
+        timed(xla_rank1, table, ids, coef, h, hidx), 1
+    )
+    for br in (8, 16, 32):
+        results[f"scatter_rank1_pallas_b{br}_us"] = round(
+            timed(
+                scatter_add_rank1, table, ids, coef, h, hidx,
+                interpret=interpret, block_rows=br,
+            ),
+            1,
+        )
+
     # Full fused train step, engine-level: default vs pallas path.
     if on_tpu:
         from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
