@@ -133,6 +133,45 @@ def _rank1_payload(cpos_g, cneg_g, C: int, n: int):
     return coefs, hidx
 
 
+def _apply_rank1_updates(
+    syn1_l, ids1_g, cpos_g, cneg_g, h_g, C, n, pm, own_range=None
+):
+    """Apply the per-pair syn1 rank-1 updates, choosing between the fused
+    Pallas scatter (Pallas mode on AND h_g fits the VMEM budget) and the
+    dense outer-product payload. Returns (syn1_l, upd1_g) where upd1_g is
+    None when the update was already applied (fused path) or the (N, d)
+    payload for the caller's scatter otherwise. ``own_range=(start, Vs)``
+    applies the rows layout's ownership masking; None = every row local
+    (dims layout). ONE implementation for both step bodies — the fuse
+    gate, payload ordering, and fallback stay in lockstep by construction.
+    """
+    fuse = pm and (
+        h_g.shape[0] * h_g.shape[1] * 4 <= _RANK1_FUSE_VMEM_BYTES
+    )
+    if fuse:
+        from glint_word2vec_tpu.ops.pallas_rows import scatter_add_rank1
+
+        coefs, hidx = _rank1_payload(cpos_g, cneg_g, C, n)
+        ids = ids1_g
+        if own_range is not None:
+            start, Vs = own_range
+            loc = ids1_g - start
+            own = (loc >= 0) & (loc < Vs)
+            coefs = jnp.where(own, coefs, 0.0)
+            ids = jnp.clip(loc, 0, Vs - 1)
+        syn1_l = scatter_add_rank1(
+            syn1_l, ids, coefs, h_g, hidx, interpret=pm == 2
+        )
+        return syn1_l, None
+    d = h_g.shape[-1]
+    d_upos = cpos_g[..., None] * h_g[:, None, :]
+    d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
+    upd1_g = jnp.concatenate(
+        [d_upos.reshape(-1, d), d_uneg.reshape(-1, d)]
+    )
+    return syn1_l, upd1_g
+
+
 class EmbeddingEngine:
     """Owns the sharded syn0/syn1 tables and all device-side ops.
 
@@ -379,41 +418,13 @@ class EmbeddingEngine:
                 ids1_g = jnp.concatenate(
                     [ctx_g.reshape(-1), negs_g.reshape(-1)]
                 )
-                fuse = pm and (
-                    h_g.shape[0] * h_g.shape[1] * 4
-                    <= _RANK1_FUSE_VMEM_BYTES
+                # Fused Pallas scatter (payload formed in VMEM) when
+                # eligible, else consumer-side outer products; ownership
+                # masking for this rows layout via own_range.
+                syn1_l, upd1_g = _apply_rank1_updates(
+                    syn1_l, ids1_g, cpos_g, cneg_g, h_g, C, n, pm,
+                    own_range=(start, Vs),
                 )
-                if fuse:
-                    # Fused-payload Pallas scatter: the (N, d) rank-1
-                    # updates are formed in VMEM inside the kernel
-                    # (ops/pallas_rows.scatter_add_rank1); h_g is pinned
-                    # whole in VMEM (gated on fitting the budget above —
-                    # larger shapes fall back to the dense path).
-                    # Ownership masking = zeroed coefs + clipped ids, as
-                    # in _scatter_rows.
-                    from glint_word2vec_tpu.ops.pallas_rows import (
-                        scatter_add_rank1,
-                    )
-
-                    coefs, hidx = _rank1_payload(cpos_g, cneg_g, C, n)
-                    loc = ids1_g - start
-                    own = (loc >= 0) & (loc < Vs)
-                    coefs = jnp.where(own, coefs, 0.0)
-                    clipped = jnp.clip(loc, 0, Vs - 1)
-                    syn1_l = scatter_add_rank1(
-                        syn1_l, clipped, coefs, h_g, hidx,
-                        interpret=pm == 2,
-                    )
-                    upd1_g = None
-                else:
-                    # Consumer-side outer products (coef x h), rank-major
-                    # along the batch axis, so ids and updates align.
-                    d = h_g.shape[-1]
-                    d_upos = cpos_g[..., None] * h_g[:, None, :]
-                    d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
-                    upd1_g = jnp.concatenate(
-                        [d_upos.reshape(-1, d), d_uneg.reshape(-1, d)]
-                    )
 
             # The center gradient is distributed over the group's rows
             # (d mean / d row = 1/count): ship the (Bl, d) gradient + the
@@ -533,31 +544,10 @@ class EmbeddingEngine:
                 ids1_g = jnp.concatenate(
                     [ctx_g.reshape(-1), negs_g.reshape(-1)]
                 )
-                fuse = pm and (
-                    h_g.shape[0] * h_g.shape[1] * 4
-                    <= _RANK1_FUSE_VMEM_BYTES
+                # Every row is local under dims: no own_range masking.
+                syn1_l, upd1_g = _apply_rank1_updates(
+                    syn1_l, ids1_g, cpos_g, cneg_g, h_g, C, n, pm
                 )
-                if fuse:
-                    # Fused-payload Pallas scatter (no ownership mask
-                    # needed: every row is local under the dims layout;
-                    # same VMEM-fit gate as the rows layout).
-                    from glint_word2vec_tpu.ops.pallas_rows import (
-                        scatter_add_rank1,
-                    )
-
-                    coefs, hidx = _rank1_payload(cpos_g, cneg_g, C, n)
-                    syn1_l = scatter_add_rank1(
-                        syn1_l, ids1_g, coefs, h_g, hidx,
-                        interpret=pm == 2,
-                    )
-                    upd1_g = None
-                else:
-                    dl = h_g.shape[-1]
-                    d_upos = cpos_g[..., None] * h_g[:, None, :]
-                    d_uneg = cneg_g[..., None] * h_g[:, None, None, :]
-                    upd1_g = jnp.concatenate(
-                        [d_upos.reshape(-1, dl), d_uneg.reshape(-1, dl)]
-                    )
                 loss_local = co.loss
 
             dcen_g = lax.all_gather(d_center_l / cnt, DATA_AXIS, tiled=True)

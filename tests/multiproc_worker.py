@@ -108,6 +108,17 @@ def main() -> int:
         loaded.transform("w0"), ref_vec, rtol=1e-5, atol=1e-6
     )
 
+    # --- dims (column-sharded) layout on the same global mesh ---------
+    # Same seed + per-global-row draws => the dims run must reproduce the
+    # rows run's vectors up to float reduction order, across processes.
+    model_dims = Word2Vec(**common, layout="dims").fit(sentences)
+    np.testing.assert_allclose(
+        model_dims.transform("w0"), ref_vec, rtol=1e-4, atol=1e-5
+    )
+    syn_d = model_dims.find_synonyms("w0", 5)
+    assert len(syn_d) == 5 and all(np.isfinite(s) for _, s in syn_d)
+    multihost_utils.sync_global_devices("dims_done")
+
     # --- checkpoint/resume across processes ---------------------------
     ck = os.path.join(workdir, "ck")
     Word2Vec(**common).fit(sentences, checkpoint_dir=ck, stop_after_epochs=1)
