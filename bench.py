@@ -26,11 +26,12 @@ diagnostic JSON line. It always exits 0 with one JSON line on stdout.
 Environment knobs:
   BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_SPC (minibatches per device
   dispatch = scan length), BENCH_SHARED_NEG (pool size for the shared mode),
-  BENCH_MODES (default "per_pair,per_pair_bf16c,shared_bf16c"; the "_bf16c"
-  suffix = bf16 MXU operands with f32 accumulation for the step's dense
-  contractions), BENCH_DTYPE (table dtype, default float32 to keep the
-  headline comparable across rounds; scripts/bench_sweep.py sweeps the
-  bfloat16 scale geometry),
+  BENCH_MODES (default "per_pair,per_pair_bf16ct,shared_bf16ct"; suffixes:
+  "_bf16c" = bf16 MXU operands with f32 accumulation, "_bf16t" = bf16
+  TABLES for that mode (overriding BENCH_DTYPE; halves gather/scatter
+  bytes), "_bf16ct" = both), BENCH_DTYPE (run-level table dtype, default
+  float32 so the suffixless per_pair headline stays comparable across
+  rounds; each mode's effective table dtype is echoed in its results),
   BENCH_PLATFORM (force a JAX platform), BENCH_ATTEMPT_TIMEOUT (seconds per
   worker attempt, default 600; the retry attempt is capped at 300),
   BENCH_MIN_SECONDS (timed-loop floor).
@@ -81,11 +82,12 @@ def _config_from_env():
         # rework); the bf16-table geometry is swept by
         # scripts/bench_sweep.py, which sets BENCH_DTYPE explicitly.
         "dtype": os.environ.get("BENCH_DTYPE", "float32"),
-        # Mode suffix "_bf16c" = bf16 MXU operands (f32 accumulation) for
-        # the step's dense contractions; no suffix = f32 operands (the
-        # exactness-tested numerics).
+        # Mode suffixes: _bf16c = bf16 MXU operands, _bf16t = bf16 tables,
+        # _bf16ct = both; no suffix = f32 (exactness-tested numerics).
+        # Defaults: the r03-comparable headline + the full per-pair fast
+        # path + the fastest estimator at its fast config.
         "modes": os.environ.get(
-            "BENCH_MODES", "per_pair,per_pair_bf16c,shared_bf16c"
+            "BENCH_MODES", "per_pair,per_pair_bf16ct,shared_bf16ct"
         ),
     }
 
@@ -100,7 +102,7 @@ def _flops_per_step(mode: str, cfg) -> float:
     2BCd+2BSd, d_pool 2BSd, outer+scatter 2BCd+Bd+Sd => ~6BCd + 6BSd.
     """
     B, C, d, n = cfg["batch"], cfg["context_lanes"], cfg["dim"], cfg["negatives"]
-    estimator, _ = _mode_parts(mode)
+    estimator, _, _ = _mode_parts(mode)
     if estimator == "per_pair":
         return 6.0 * B * C * d * (1 + n) + B * d
     S = cfg["shared_negatives"]
@@ -113,10 +115,21 @@ def _flops_per_step(mode: str, cfg) -> float:
 
 
 def _mode_parts(mode: str):
-    """Split a mode name into (estimator, compute_dtype)."""
-    if mode.endswith("_bf16c"):
-        return mode[: -len("_bf16c")], "bfloat16"
-    return mode, "float32"
+    """Split a mode name into (estimator, compute_dtype, table_dtype).
+
+    Suffixes: "_bf16c" = bf16 MXU operands; "_bf16t" = bf16 tables
+    (halves gather/scatter HBM bytes); "_bf16ct" = both. No suffix = f32
+    everywhere (the exactness-tested reference numerics). table_dtype is
+    None when the mode doesn't override the run-level BENCH_DTYPE.
+    """
+    for suf, cd, td in (
+        ("_bf16ct", "bfloat16", "bfloat16"),
+        ("_bf16c", "bfloat16", None),
+        ("_bf16t", "float32", "bfloat16"),
+    ):
+        if mode.endswith(suf):
+            return mode[: -len(suf)], cd, td
+    return mode, "float32", None
 
 
 def _bench_mode(jax, mesh, cfg, mode: str, np):
@@ -124,7 +137,7 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
 
     V, d, B = cfg["vocab"], cfg["dim"], cfg["batch"]
     spc, C, n = cfg["steps_per_call"], cfg["context_lanes"], cfg["negatives"]
-    estimator, compute_dtype = _mode_parts(mode)
+    estimator, compute_dtype, table_dtype = _mode_parts(mode)
     shared = cfg["shared_negatives"] if estimator == "shared" else 0
 
     # Zipf-ish counts: realistic index skew for gathers and the noise table.
@@ -133,7 +146,7 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
 
     eng = EmbeddingEngine(
         mesh, V, d, counts, num_negatives=n, seed=0,
-        shared_negatives=shared, dtype=cfg["dtype"],
+        shared_negatives=shared, dtype=table_dtype or cfg["dtype"],
         compute_dtype=compute_dtype,
     )
 
@@ -182,6 +195,10 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
         "compile_s": round(compile_s, 1),
         "flops_per_sec": round(flops, 3),
         "timed_steps": steps,
+        # Effective dtypes for THIS mode (suffixes override BENCH_DTYPE),
+        # so the artifact is self-describing.
+        "table_dtype": table_dtype or cfg["dtype"],
+        "compute_dtype": compute_dtype,
     }
 
 
@@ -204,7 +221,7 @@ def worker_main() -> None:
     results = {}
     peaks = {}
     for mode in modes:
-        _, compute_dtype = _mode_parts(mode)
+        _, compute_dtype, _ = _mode_parts(mode)
         peak = (
             _peak_for(dev.device_kind, compute_dtype)
             if dev.platform == "tpu" else None

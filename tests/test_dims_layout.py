@@ -196,3 +196,35 @@ def test_topk_batch_empty_query_batch(layout):
     eng = _mk(layout, 2, 4)
     sims, idx = eng.top_k_cosine_batch(np.zeros((0, D), np.float32), 5)
     assert sims.shape == (0, 5) and idx.shape == (0, 5)
+
+
+def test_dims_data_axis_exchange_ships_scalars_not_payloads():
+    # Mirror of test_engine.py's rows-layout exchange test: the dims
+    # layout's data-axis all-gathers must also carry only h slices +
+    # scalar coefficients + ids, never expanded rank-1 payloads.
+    B, C, D2 = 16, 5, 64
+    counts = np.arange(V, 0, -1).astype(np.int64) * 10
+    eng = EmbeddingEngine(
+        make_mesh(4, 2), V, D2, counts, num_negatives=4, layout="dims"
+    )
+    centers, contexts, mask = _batch(B=B, C=C)
+    lowered = eng._train_step.lower(
+        eng.syn0, eng.syn1, eng._prob, eng._alias,
+        jnp.asarray(centers[:, None]), jnp.ones((B, 1), jnp.float32),
+        jnp.asarray(contexts), jnp.asarray(mask),
+        jax.random.PRNGKey(0), jnp.float32(0.05),
+    )
+    hlo = lowered.compile().as_text()
+    gathered = 0
+    for m in re.finditer(r"= (\([^)]*\)|[^ ]+) all-gather", hlo):
+        for t in re.finditer(r"(f32|s32|u32|bf16)\[([\d,]*)\]", m.group(1)):
+            dims_ = [int(x) for x in t.group(2).split(",") if x]
+            elems = int(np.prod(dims_)) if dims_ else 1
+            gathered += elems * (2 if t.group(1) == "bf16" else 4)
+    n = eng.num_negatives
+    dl = eng.cols_per_shard
+    expanded_payload = B * C * (1 + n) * dl * 4
+    # h slice + d_center slice (2*B*dl) + coef scalars + ids + group mask.
+    budget = 4 * (2 * B * dl + 4 * B * C * (1 + n) + 2 * B) * 2
+    assert 0 < gathered <= budget, (gathered, budget)
+    assert gathered < expanded_payload, (gathered, expanded_payload)
