@@ -1,0 +1,37 @@
+#!/bin/bash
+# One-shot on-chip measurement session: runs every TPU-dependent harness
+# in priority order with per-step timeouts and appends to a log. Run when
+# the chip/tunnel is reachable:
+#
+#   bash scripts/tpu_session.sh [LOGFILE]
+#
+# Produces: profile_step partials+json, pallas_bench json (the Pallas
+# default decision), bench.py line (BENCH_r* evidence), SCALE.json
+# (writes into the repo), BENCH_SWEEP.json (target-geometry sweep).
+set -u
+cd "$(dirname "$0")/.."
+L="${1:-/tmp/tpu_session.log}"
+echo "=== TPU session start $(date) ===" >> "$L"
+
+echo "--- profile_step" >> "$L"
+timeout 1500 python scripts/profile_step.py \
+  --out /tmp/profile_tpu_partial.json > /tmp/profile_tpu.json 2>>"$L"
+echo "profile rc=$?" >> "$L"
+
+echo "--- pallas_bench" >> "$L"
+timeout 1200 python scripts/pallas_bench.py > /tmp/pallas_tpu.json 2>>"$L"
+echo "pallas rc=$?" >> "$L"
+
+echo "--- bench default" >> "$L"
+timeout 1200 python bench.py > /tmp/bench_tpu.json 2>>"$L"
+echo "bench rc=$?" >> "$L"
+
+echo "--- scale_test" >> "$L"
+timeout 1800 python scripts/scale_test.py > /tmp/scale_tpu.json 2>>"$L"
+echo "scale rc=$?" >> "$L"
+
+echo "--- bench_sweep" >> "$L"
+timeout 3600 python scripts/bench_sweep.py > /tmp/sweep_tpu.json 2>>"$L"
+echo "sweep rc=$?" >> "$L"
+
+echo "=== TPU session done $(date) ===" >> "$L"
