@@ -505,15 +505,20 @@ class Word2Vec:
                     ]
                     alphas = [a for a, _ in sched]
                     wds = [w for _, w in sched]
+                # The whole device interaction counts as "step" time:
+                # the dispatch AND the loss reads (record_step syncs on
+                # the device every log_every steps — with async dispatch
+                # that wait IS the device time, and leaving it outside
+                # both buckets made host_frac meaningless).
                 with metrics.timing("step"):
                     losses = self._train_batches(
                         engine, group, base_key, step, np.asarray(alphas, np.float32)
                     )
-                for i in range(n_real):
-                    step += 1
-                    metrics.record_step(
-                        wds[i], loss=losses[i], alpha=alphas[i]
-                    )
+                    for i in range(n_real):
+                        step += 1
+                        metrics.record_step(
+                            wds[i], loss=losses[i], alpha=alphas[i]
+                        )
                 step += len(group) - n_real  # padded steps consumed keys too
                 g += 1
             stopping = (

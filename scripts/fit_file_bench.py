@@ -1,0 +1,116 @@
+"""End-to-end fit_file() throughput: the whole pipeline, host included.
+
+bench.py measures the device step in isolation; this measures what a user
+gets from ``Word2Vec(...).fit_file(corpus)`` — vocab scan, streaming
+encode, native subsample+window pass, prefetch, device dispatch — and
+records the host/device time split (``host_frac`` tells you whether
+infeed is the binding constraint at the chip's words/sec; SURVEY.md §7
+hard part 5, round-3 directive #6).
+
+Generates a Zipf corpus file once (~`FITBENCH_WORDS` words over
+`FITBENCH_VOCAB` distinct tokens) under /tmp and reuses it. Writes
+FITFILE.json at the repo root when run on a TPU; prints JSON always.
+
+Run:  python scripts/fit_file_bench.py      (chip)
+      GLINT_FITBENCH_PLATFORM=cpu FITBENCH_WORDS=2000000 \
+          python scripts/fit_file_bench.py  (mechanism smoke)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_FITBENCH_PLATFORM"))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def ensure_corpus(path: str, total_words: int, vocab: int) -> int:
+    """Generate the Zipf corpus file if absent; return the actual word
+    count of the file used (a pre-existing file may differ from the
+    requested size — the artifact must record what was measured)."""
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        with open(path) as f:
+            return sum(len(line.split()) for line in f)
+    rng = np.random.default_rng(0)
+    sent_len = 40
+    with open(path + ".tmp", "w") as f:
+        written = 0
+        while written < total_words:
+            ids = np.minimum(
+                (rng.random(sent_len * 2500) ** 4 * vocab), vocab - 1
+            ).astype(np.int64)
+            rows = ids.reshape(-1, sent_len)
+            f.write(
+                "\n".join(
+                    " ".join(f"w{t}" for t in row) for row in rows
+                )
+                + "\n"
+            )
+            written += ids.size
+    os.replace(path + ".tmp", path)
+    return written
+
+
+def main():
+    V = int(os.environ.get("FITBENCH_VOCAB", 1_000_000))
+    total = int(os.environ.get("FITBENCH_WORDS", 50_000_000))
+    B = int(os.environ.get("FITBENCH_BATCH", 8192))
+    spc = int(os.environ.get("FITBENCH_SPC", 32))
+    dtype = os.environ.get("FITBENCH_DTYPE", "bfloat16")
+    corpus = os.environ.get(
+        "FITBENCH_CORPUS", f"/tmp/fitbench_{V}_{total}.txt"
+    )
+
+    dev = jax.devices()[0]
+    t0 = time.time()
+    actual_words = ensure_corpus(corpus, total, V)
+    gen_s = time.time() - t0
+
+    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    t0 = time.time()
+    model = Word2Vec(
+        mesh=make_mesh(1, 1, devices=[dev]),
+        vector_size=int(os.environ.get("FITBENCH_DIM", 300)),
+        batch_size=B, min_count=1, num_iterations=1, seed=1,
+        steps_per_call=spc, dtype=dtype,
+        compute_dtype=os.environ.get("FITBENCH_COMPUTE", "bfloat16"),
+        shared_negatives=int(os.environ.get("FITBENCH_SHARED", 0)),
+    ).fit_file(corpus)
+    fit_s = time.time() - t0
+
+    tm = model.training_metrics
+    out = {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "corpus_words": actual_words,
+        "distinct_tokens": V,
+        "batch": B,
+        "steps_per_call": spc,
+        "table_dtype": dtype,
+        "vocab_built": model.vocab.size,
+        "corpus_gen_seconds": round(gen_s, 1),
+        "fit_wall_seconds": round(fit_s, 1),
+        "training_metrics": tm,
+    }
+    print(json.dumps(out))
+    if dev.platform == "tpu":
+        dst = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "FITFILE.json",
+        )
+        with open(dst, "w") as f:
+            json.dump(out, f, indent=2)
+    model.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -7,10 +7,13 @@
 #
 # Produces: profile_step partials+json, pallas_bench json (the Pallas
 # default decision), bench.py line (BENCH_r* evidence), SCALE.json
-# (writes into the repo), BENCH_SWEEP.json (target-geometry sweep).
+# (writes into the repo), FITFILE.json + /tmp/fitfile_tpu.json
+# (end-to-end fit_file throughput incl. host_frac), BENCH_SWEEP.json
+# (target-geometry sweep).
 set -u
-cd "$(dirname "$0")/.."
 L="${1:-/tmp/tpu_session.log}"
+case "$L" in /*) ;; *) L="$(pwd)/$L" ;; esac  # absolutize before cd
+cd "$(dirname "$0")/.." || exit 1
 echo "=== TPU session start $(date) ===" >> "$L"
 
 echo "--- profile_step" >> "$L"
@@ -29,6 +32,10 @@ echo "bench rc=$?" >> "$L"
 echo "--- scale_test" >> "$L"
 timeout 1800 python scripts/scale_test.py > /tmp/scale_tpu.json 2>>"$L"
 echo "scale rc=$?" >> "$L"
+
+echo "--- fit_file_bench" >> "$L"
+timeout 1800 python scripts/fit_file_bench.py > /tmp/fitfile_tpu.json 2>>"$L"
+echo "fitfile rc=$?" >> "$L"
 
 echo "--- bench_sweep" >> "$L"
 timeout 3600 python scripts/bench_sweep.py > /tmp/sweep_tpu.json 2>>"$L"
