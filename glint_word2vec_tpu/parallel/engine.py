@@ -221,6 +221,16 @@ class EmbeddingEngine:
             scalar logit partials (the dot products the reference's
             ``dotprod`` servers return). Per-chip HBM traffic for the
             sparse row accesses divides by the model-axis size.
+
+        Guidance: per-chip table memory is identical (V*d/n either way).
+        For TRAINING at num_model > 1, "dims" is the better default —
+        its model-axis collectives are ~d/(1+overlap) times smaller and
+        its sparse HBM traffic scales down with the axis. "rows" wins
+        for query-heavy serving at huge vocab (top-k batch scores stay
+        (Q, V/n) per shard instead of (Q, V)) and when d is too small to
+        split usefully (d < 128 * num_model leaves sublane-starved
+        slices). Both train bit-equivalently up to reduction order, and
+        checkpoints re-home across layouts, so the choice is reversible.
         """
         if vocab_size <= 0 or dim <= 0:
             raise ValueError("vocab_size and dim must be > 0")
