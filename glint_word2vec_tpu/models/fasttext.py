@@ -69,6 +69,12 @@ class FastTextWord2Vec(Word2Vec):
 
     # Family hooks -----------------------------------------------------
 
+    def _device_corpus_eligible(self) -> bool:
+        # Subword centers need the host-side group expansion
+        # (_train_batches below); the device corpus batcher assembles
+        # word-level centers only.
+        return False
+
     def _make_engine(self, mesh, vocab: Vocabulary):
         from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
 

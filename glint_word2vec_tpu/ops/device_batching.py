@@ -1,0 +1,104 @@
+"""On-device skip-gram batch assembly from a device-resident corpus.
+
+The host pipeline (corpus/batching.py) streams fixed-shape minibatches and
+re-uploads ~60 bytes/center-position per step. This module is the
+TPU-native alternative: the flat encoded corpus (``ids int32[N]``,
+``offsets int32[S+1]`` — corpus/vocab.encode_file's representation) is
+uploaded to HBM ONCE (~4 bytes per kept word), and every minibatch is
+assembled *inside* the jitted train scan from nothing but a position
+counter and the step's PRNG key. Host->device traffic per dispatch drops
+from O(steps_per_call * batch * context) to a handful of scalars.
+
+Semantics mirror the reference's windowing exactly as restated in
+corpus/batching.py (mllib:381-390): for center position ``i`` draw
+``b ~ U[0, window)`` and take context positions ``[max(0, i-b),
+min(i+b, len)) \\ {i}`` — the half-open upper bound inherited from
+Scala's ``until``, hence lanes spanning offsets ``[-(W-1), W-2]`` and
+``context_width(W) = 2W-3``. Sentence bounds come from ``offsets`` via
+``searchsorted``. Without subsampling the center-position stream is the
+corpus in order — exactly the host batcher's packing — so the device
+path is batch-for-batch identical to the Python path modulo the window
+shrink RNG stream (device threefry vs host PCG64; the host native/C++
+pass already diverges from Python the same way).
+
+Frequency subsampling *compacts* sentences before windowing (it changes
+neighbor distances), which is a data-dependent reshape the static-shape
+scan cannot express cheaply; callers with ``subsample_ratio > 0`` keep
+the host pipeline (models/word2vec.py routes accordingly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glint_word2vec_tpu.corpus.batching import window_offsets
+
+#: Domain-separation constant for the window-shrink draws ("wind").
+WINDOW_FOLD = 0x77696E64
+
+
+def device_window_batch(
+    ids: jax.Array,  # (N,) int32 flat corpus
+    offsets: jax.Array,  # (S+1,) int32 sentence offsets
+    positions: jax.Array,  # (B,) int32 center positions (may exceed N: masked)
+    rows: jax.Array,  # (B,) int32 GLOBAL batch-row indices (key the draws)
+    key: jax.Array,
+    window: int,
+):
+    """Assemble one (centers, contexts, mask) minibatch on device.
+
+    ``positions`` beyond the corpus end yield fully-masked rows (the
+    epoch-tail padding the host batcher expresses with zero-mask rows).
+    The shrink draw for row ``i`` depends only on ``(key, rows[i])`` —
+    the per-GLOBAL-row keying of ops/sampling.sample_negatives_per_row —
+    so a data rank holding global rows [r0, r0+Bl) draws exactly what a
+    single-rank run draws for those rows while doing only O(local rows)
+    work (no global-batch over-draw).
+    """
+    N = ids.shape[0]
+    W = int(window)
+
+    # Both bounds: upload_corpus permits N up to 2**31-1, so a tail
+    # group's positions can overflow int32 and wrap negative — without
+    # the >= 0 check a wrapped position would clip to 0 and train real
+    # updates on sentence-0 windows instead of masking out.
+    in_corpus = (positions >= 0) & (positions < N)
+    p = jnp.clip(positions, 0, max(N - 1, 0))
+    sent = jnp.searchsorted(offsets, p, side="right") - 1
+    start = offsets[sent]
+    end = offsets[sent + 1]
+
+    base = jax.random.fold_in(key, WINDOW_FOLD)
+    keys = jax.vmap(lambda r: jax.random.fold_in(base, r))(rows)
+    b = jax.vmap(
+        lambda k: jax.random.randint(k, (), 0, W, dtype=jnp.int32)
+    )(keys)
+    offs = jnp.asarray(window_offsets(W), dtype=jnp.int32)  # (C,) static
+    cpos = p[:, None] + offs[None, :]
+    valid = (
+        (offs[None, :] >= -b[:, None])
+        & (offs[None, :] <= b[:, None] - 1)
+        & (cpos >= start[:, None])
+        & (cpos < end[:, None])
+        & in_corpus[:, None]
+    )
+    centers = jnp.where(in_corpus, ids[p], 0).astype(jnp.int32)
+    contexts = jnp.where(valid, ids[jnp.clip(cpos, 0, max(N - 1, 0))], 0)
+    return centers, contexts.astype(jnp.int32), valid.astype(jnp.float32)
+
+
+def corpus_words_done(offsets: np.ndarray, end_position: int) -> int:
+    """Host-side words_done after consuming center positions [0, end).
+
+    Matches the host batcher's accounting (corpus/batching.py): a
+    sentence's full word count is added as soon as ANY of its positions
+    is consumed, so the value after a batch ending inside sentence ``j``
+    is ``offsets[j+1]``.
+    """
+    if end_position <= 0:
+        return 0
+    end_position = min(int(end_position), int(offsets[-1]))
+    j = int(np.searchsorted(offsets, end_position - 1, side="right")) - 1
+    return int(offsets[j + 1])

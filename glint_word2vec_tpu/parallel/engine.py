@@ -73,6 +73,18 @@ from glint_word2vec_tpu.parallel.mesh import (
 )
 
 
+def _host_or_device(a, dtype=None):
+    """Normalize a batch input WITHOUT moving it across the host/device
+    boundary: device-resident ``jax.Array`` inputs are kept on device
+    (cast in place if needed); anything else becomes a numpy array. The
+    previous unconditional ``np.asarray`` forced a blocking device->host
+    copy (plus a re-upload) whenever a caller fed device-resident batches
+    — exactly the zero-copy path a device-side data pipeline wants."""
+    if isinstance(a, jax.Array):
+        return a.astype(dtype) if dtype is not None and a.dtype != dtype else a
+    return np.asarray(a) if dtype is None else np.asarray(a, dtype=dtype)
+
+
 def _pull_rows(table_l, idx, start, rows_per_shard, pallas_mode=0):
     """Gather global rows from a shard-local table: contribute owned rows,
     zeros elsewhere, then psum over the model axis. The TPU analogue of the
@@ -635,6 +647,66 @@ class EmbeddingEngine:
             donate_argnums=(0, 1),
         )
 
+        num_data = self.num_data
+        self._corpus_scan_cache: dict = {}
+
+        def make_corpus_scan(B: int, W: int):
+            # Corpus-resident scan: batches are assembled ON DEVICE from
+            # the uploaded flat corpus (ops/device_batching) — the only
+            # per-dispatch host->device traffic is scalars. Step i of the
+            # scan covers global center positions
+            # [pstart + i*B, pstart + (i+1)*B); this rank materializes
+            # only its Bl = B/num_data rows. Keys follow the exact
+            # fold_in(base_key, step0 + i) schedule of local_train_scan,
+            # so negatives match a host-batched run step for step.
+            from glint_word2vec_tpu.ops.device_batching import (
+                device_window_batch,
+            )
+
+            Bl = B // num_data
+
+            def local_corpus_scan(syn0_l, syn1_l, prob, alias, ids, soffs,
+                                  pstart, base_key, step0, alphas_k):
+                drank = lax.axis_index(DATA_AXIS)
+                rows_l = (drank * Bl + jnp.arange(Bl)).astype(jnp.int32)
+
+                def body(carry, xs):
+                    s0, s1 = carry
+                    i, alpha = xs
+                    key = jax.random.fold_in(base_key, step0 + i)
+                    positions = (
+                        pstart + jnp.int32(i) * jnp.int32(B) + rows_l
+                    )
+                    centers, contexts, mask = device_window_batch(
+                        ids, soffs, positions, rows_l, key, W
+                    )
+                    cmask = jnp.ones((Bl, 1), jnp.float32)
+                    s0, s1, loss = step_body(
+                        s0, s1, prob, alias, centers[:, None], cmask,
+                        contexts, mask, key, alpha,
+                    )
+                    return (s0, s1), loss
+
+                K = alphas_k.shape[0]
+                (syn0_l, syn1_l), losses = lax.scan(
+                    body,
+                    (syn0_l, syn1_l),
+                    (jnp.arange(K, dtype=jnp.uint32), alphas_k),
+                )
+                return syn0_l, syn1_l, losses
+
+            return jax.jit(
+                self._shard_map(
+                    local_corpus_scan,
+                    in_specs=(tspec, tspec, rep, rep, rep, rep,
+                              rep, rep, rep, rep),
+                    out_specs=(tspec, tspec, rep),
+                ),
+                donate_argnums=(0, 1),
+            )
+
+        self._make_corpus_scan = make_corpus_scan
+
         dims = self.layout == "dims"
         dcols = self.cols_per_shard
         dim_real = self.dim
@@ -842,18 +914,21 @@ class EmbeddingEngine:
 
         The fused equivalent of one ``dotprod`` -> gradient-scale ->
         ``adjust`` round trip (mllib:421-425). Batch rows must be divisible
-        by the data-axis size.
+        by the data-axis size. Inputs may be host (numpy) or device-resident
+        (jax) arrays; device arrays are used in place — no host bounce.
         """
-        centers = np.asarray(centers)
+        centers = _host_or_device(centers)
         return self.train_step_grouped(
-            centers[:, None], np.ones_like(centers, dtype=np.float32)[:, None],
+            centers[:, None],
+            np.ones((centers.shape[0], 1), dtype=np.float32),
             contexts, mask, key, alpha,
         )
 
-    def _device_batch(self, *host_arrays, data_axis: int):
-        """Place host batch arrays on the mesh. Single-process: plain
-        ``jnp.asarray`` (jit shards them). Multi-host: each process passes
-        only ITS data-axis rows; the global batch is assembled with every
+    def _device_batch(self, *arrays, data_axis: int):
+        """Place batch arrays on the mesh. Single-process: plain
+        ``jnp.asarray`` (a no-op for already-device-resident inputs; jit
+        shards them). Multi-host: each process passes only ITS data-axis
+        rows as HOST arrays; the global batch is assembled with every
         shard staying on the host that produced it
         (distributed.make_global_batch — the Spark partition-locality
         analogue, mllib:345)."""
@@ -863,9 +938,10 @@ class EmbeddingEngine:
             )
 
             return make_global_batch(
-                self.mesh, *host_arrays, data_axis=data_axis
+                self.mesh, *(np.asarray(a) for a in arrays),
+                data_axis=data_axis,
             )
-        return tuple(jnp.asarray(a) for a in host_arrays)
+        return tuple(jnp.asarray(a) for a in arrays)
 
     def train_step_grouped(
         self, center_groups, group_mask, contexts, mask, key, alpha
@@ -875,10 +951,10 @@ class EmbeddingEngine:
         gradient splits 1/count over the group's rows). Word-level training
         is the width-1 special case used by :meth:`train_step`."""
         cg, gm, cx, mk = self._device_batch(
-            np.asarray(center_groups),
-            np.asarray(group_mask, dtype=np.float32),
-            np.asarray(contexts),
-            np.asarray(mask, dtype=np.float32),
+            _host_or_device(center_groups),
+            _host_or_device(group_mask, jnp.float32),
+            _host_or_device(contexts),
+            _host_or_device(mask, jnp.float32),
             data_axis=0,
         )
         B = cg.shape[0]
@@ -909,7 +985,7 @@ class EmbeddingEngine:
         step pays one host round-trip per K minibatches, with all K updates
         running back-to-back on device.
         """
-        centers_k = np.asarray(centers_k)
+        centers_k = _host_or_device(centers_k)
         K, B = centers_k.shape[0], centers_k.shape[1]
         return self.train_steps_grouped(
             centers_k[:, :, None],
@@ -927,10 +1003,10 @@ class EmbeddingEngine:
         step's batch (B here = local rows); the global batch is assembled
         across processes before dispatch."""
         cg, gm, cx, mk = self._device_batch(
-            np.asarray(center_groups_k),
-            np.asarray(group_mask_k, dtype=np.float32),
-            np.asarray(contexts_k),
-            np.asarray(mask_k, dtype=np.float32),
+            _host_or_device(center_groups_k),
+            _host_or_device(group_mask_k, jnp.float32),
+            _host_or_device(contexts_k),
+            _host_or_device(mask_k, jnp.float32),
             data_axis=1,
         )
         B = cg.shape[1]
@@ -942,6 +1018,66 @@ class EmbeddingEngine:
             self.syn0, self.syn1, self._prob, self._alias,
             cg, gm, cx, mk,
             base_key, jnp.uint32(step0),
+            jnp.asarray(alphas, dtype=jnp.float32),
+        )
+        self._norms_cache = None
+        return losses
+
+    # ------------------------------------------------------------------
+    # Corpus-resident training (device-side batch assembly)
+    # ------------------------------------------------------------------
+
+    def upload_corpus(self, ids: np.ndarray, offsets: np.ndarray) -> None:
+        """Upload the flat encoded corpus (corpus/vocab.encode_file's
+        ``(ids, offsets)``) to device HBM once. Subsequent
+        :meth:`train_steps_corpus` dispatches assemble minibatches
+        entirely on device (ops/device_batching) — per-dispatch
+        host->device traffic drops to scalars. ~4 bytes/word of HBM,
+        replicated per device."""
+        n = int(np.asarray(ids).shape[0])
+        if n >= 2**31 or int(np.asarray(offsets)[-1]) != n:
+            raise ValueError(
+                "corpus must have offsets[-1] == len(ids) < 2**31 "
+                f"(got len(ids)={n})"
+            )
+        self._corpus = (
+            jnp.asarray(ids, dtype=jnp.int32),
+            jnp.asarray(offsets, dtype=jnp.int32),
+        )
+
+    @property
+    def corpus_positions(self) -> int:
+        """Total center positions of the uploaded corpus (= its words)."""
+        if getattr(self, "_corpus", None) is None:
+            raise ValueError("no corpus uploaded (call upload_corpus first)")
+        return int(self._corpus[0].shape[0])
+
+    def train_steps_corpus(
+        self, start_position: int, batch_size: int, window: int,
+        base_key, alphas, step0: int = 0
+    ) -> jax.Array:
+        """K = len(alphas) scanned minibatches over the uploaded corpus,
+        starting at flat center position ``start_position``. Batch i
+        covers positions [start + i*B, start + (i+1)*B); positions past
+        the corpus end become zero-mask rows (the epoch tail). Returns
+        the (K,) per-step losses. Key schedule matches
+        :meth:`train_steps` exactly."""
+        if getattr(self, "_corpus", None) is None:
+            raise ValueError("no corpus uploaded (call upload_corpus first)")
+        B, W = int(batch_size), int(window)
+        if B % self.num_data:
+            raise ValueError(
+                f"batch size {B} not divisible by data axis {self.num_data}"
+            )
+        fn = self._corpus_scan_cache.get((B, W))
+        if fn is None:
+            fn = self._corpus_scan_cache[(B, W)] = self._make_corpus_scan(
+                B, W
+            )
+        ids, soffs = self._corpus
+        self.syn0, self.syn1, losses = fn(
+            self.syn0, self.syn1, self._prob, self._alias, ids, soffs,
+            jnp.int32(start_position), base_key, jnp.uint32(step0),
             jnp.asarray(alphas, dtype=jnp.float32),
         )
         self._norms_cache = None
@@ -1292,12 +1428,14 @@ class EmbeddingEngine:
 
     def destroy(self) -> None:
         """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
-        for a in (self.syn0, self.syn1, self._prob, self._alias):
+        corpus = getattr(self, "_corpus", None) or ()
+        for a in (self.syn0, self.syn1, self._prob, self._alias, *corpus):
             try:
                 a.delete()
             except Exception:
                 pass
         self.syn0 = self.syn1 = self._prob = self._alias = None
+        self._corpus = None
         self._norms_cache = None
 
     @property

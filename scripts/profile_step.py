@@ -230,21 +230,27 @@ def main():
         sample_negatives_per_row,
     )
 
+    # prob/alias MUST be jit arguments, not closed-over constants: baked-in
+    # (V,)-sized constants made the first version of this measurement read
+    # 9.7ms/call on the chip (the tunnel re-ships jit constants per call),
+    # 10x the cost of the full train step that *contains* the sampling.
     prob = jnp.asarray(rng.random(V, dtype=np.float32))
     alias = jnp.asarray(rng.integers(0, V, V), jnp.int32)
     note("sampling...")
     res["sample_negatives_us"] = timeit(
-        jax.jit(lambda k: sample_negatives(k, prob, alias, (B, C, n)).sum()),
-        key,
+        jax.jit(
+            lambda k, pr, al: sample_negatives(k, pr, al, (B, C, n)).sum()
+        ),
+        key, prob, alias,
     )
     rows = jnp.arange(B, dtype=jnp.int32)
     res["sample_negatives_per_row_us"] = timeit(
         jax.jit(
-            lambda k: sample_negatives_per_row(
-                k, prob, alias, rows, (C, n)
+            lambda k, pr, al, r: sample_negatives_per_row(
+                k, pr, al, r, (C, n)
             ).sum()
         ),
-        key,
+        key, prob, alias, rows,
     )
     flush()
     print(json.dumps(res, indent=2))

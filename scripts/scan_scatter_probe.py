@@ -1,0 +1,179 @@
+"""Probe the three open on-chip questions from the round-4 profile run.
+
+1. Why does the scan path (train_steps, the production hot path used by
+   bench.py) cost ~1900us/step when a single train_step costs ~955us?
+   Sweep spc in {1, 4, 16, 32} with (a) numpy inputs (bench.py's exact
+   pattern, includes host->device transfer over the tunnel) and
+   (b) pre-device-put inputs (isolates the device-side scan cost).
+   A per-CALL fixed cost (transfer/dispatch latency) shows up as
+   time/step ~ a + b/spc; a per-STEP cost (e.g. a scan carry copy)
+   shows up as a flat offset at every spc.
+
+2. Why is the bf16 tables+compute step 2.3x SLOWER than f32?
+   Micro-measure gather and scatter-add against bf16 vs f32 tables, and
+   the full step in the three dtype configs (f32, bf16 tables only,
+   bf16 tables+compute).
+
+3. What does sampling actually cost? The profile_step.py numbers
+   (9.7ms!) closed over the (V,) prob/alias arrays as jit CONSTANTS,
+   which the axon tunnel appears to re-ship per call; here they are
+   explicit jit arguments, matching how the engine step receives them.
+
+Usage: python scripts/scan_scatter_probe.py [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_PROFILE_PLATFORM"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from profile_step import note, timeit, timeit_donated  # noqa: E402
+
+V, d, B, C, n = 1_000_000, 300, 8192, 7, 5
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/scan_scatter_probe.json")
+    args = ap.parse_args()
+    res = {"device": str(jax.devices()[0])}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=2)
+
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1, 1, devices=[jax.devices()[0]])
+    ranks = np.arange(1, V + 1, dtype=np.float64)
+    counts = np.maximum(1e9 / ranks, 1.0).astype(np.int64)
+    p = counts / counts.sum()
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+
+    # ---------------- 1. scan vs single step, transfer on/off ----------
+    eng = EmbeddingEngine(mesh, V, d, counts, num_negatives=n, seed=0)
+
+    centers = rng.choice(V, size=(B,), p=p).astype(np.int32)
+    contexts = rng.choice(V, size=(B, C), p=p).astype(np.int32)
+    mask = (rng.random((B, C)) < 0.85).astype(np.float32)
+
+    note("single step (numpy inputs)...")
+    res["single_step_numpy_us"] = timeit(
+        lambda: eng.train_step(centers, contexts, mask, key, 0.025)
+    )
+    dc, dx, dm = map(jax.device_put, (centers, contexts, mask))
+    jax.block_until_ready(dm)
+    note("single step (device inputs)...")
+    res["single_step_device_us"] = timeit(
+        lambda: eng.train_step(dc, dx, dm, key, 0.025)
+    )
+    flush()
+
+    for spc in (1, 4, 16, 32):
+        ck = rng.choice(V, size=(spc, B), p=p).astype(np.int32)
+        xk = rng.choice(V, size=(spc, B, C), p=p).astype(np.int32)
+        mk = (rng.random((spc, B, C)) < 0.85).astype(np.float32)
+        al = np.full(spc, 0.025, np.float32)
+        note(f"scan spc={spc} (numpy inputs)...")
+        res[f"scan{spc}_numpy_us_per_step"] = round(
+            timeit(
+                lambda: eng.train_steps(ck, xk, mk, key, al, 0), iters=6
+            )
+            / spc,
+            1,
+        )
+        dck, dxk, dmk, dal = map(jax.device_put, (ck, xk, mk, al))
+        jax.block_until_ready(dal)
+        note(f"scan spc={spc} (device inputs)...")
+        res[f"scan{spc}_device_us_per_step"] = round(
+            timeit(
+                lambda: eng.train_steps(dck, dxk, dmk, key, dal, 0), iters=6
+            )
+            / spc,
+            1,
+        )
+        flush()
+    del eng
+
+    # ---------------- 2. bf16 vs f32 sparse traffic ---------------------
+    def gen(key, dtype):
+        ks = jax.random.split(key, 3)
+        table = jax.random.normal(ks[0], (V, d), jnp.float32).astype(dtype)
+        u = jax.random.uniform(ks[1], (B * C * (1 + n),), jnp.float32)
+        idx = jnp.minimum((u**6 * V).astype(jnp.int32), V - 1)
+        upd = jax.random.normal(ks[2], (B * C * (1 + n), d), jnp.float32)
+        return table, idx, upd
+
+    gen = jax.jit(gen, static_argnums=1)  # dtype is a Python class
+    gather = jax.jit(lambda t, i: t[i].astype(jnp.float32).sum(0))
+    scat = jax.jit(lambda t, i, u: t.at[i].add(u.astype(t.dtype)),
+                   donate_argnums=0)
+
+    for dt, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+        table, idx, upd = gen(jax.random.PRNGKey(1), dt)
+        jax.block_until_ready(table)
+        note(f"gather {tag}...")
+        res[f"gather_{tag}_us"] = timeit(gather, table, idx)
+        note(f"scatter {tag}...")
+        res[f"scatter_{tag}_us"], table = timeit_donated(
+            scat, table, idx, upd
+        )
+        del table, idx, upd
+        flush()
+
+    # ---------------- 3. full step dtype configs ------------------------
+    for tag, kw in (
+        ("f32", dict(dtype="float32")),
+        ("bf16t", dict(dtype="bfloat16")),
+        ("bf16ct", dict(dtype="bfloat16", compute_dtype="bfloat16")),
+    ):
+        note(f"full step {tag}...")
+        e = EmbeddingEngine(mesh, V, d, counts, num_negatives=n, seed=0, **kw)
+        res[f"full_step_{tag}_us"] = timeit(
+            lambda: e.train_step(dc, dx, dm, key, 0.025)
+        )
+        del e
+        flush()
+
+    # ---------------- 4. sampling with explicit args --------------------
+    prob = jnp.asarray(rng.random(V, dtype=np.float32))
+    alias = jnp.asarray(rng.integers(0, V, V), jnp.int32)
+    jax.block_until_ready(alias)
+    from glint_word2vec_tpu.ops.sampling import (
+        sample_negatives,
+        sample_negatives_per_row,
+    )
+
+    samp = jax.jit(
+        lambda k, pr, al: sample_negatives(k, pr, al, (B, C, n)).sum()
+    )
+    note("sampling (args)...")
+    res["sample_negatives_args_us"] = timeit(samp, key, prob, alias)
+    rows = jnp.arange(B, dtype=jnp.int32)
+    samp_r = jax.jit(
+        lambda k, pr, al, r: sample_negatives_per_row(
+            k, pr, al, r, (C, n)
+        ).sum()
+    )
+    res["sample_negatives_per_row_args_us"] = timeit(
+        samp_r, key, prob, alias, rows
+    )
+    flush()
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

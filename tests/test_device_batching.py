@@ -1,0 +1,265 @@
+"""Device-side batch assembly (ops/device_batching) and the engine's
+corpus-resident train scan.
+
+Semantic ground truth is the host pipeline (corpus/batching.py): identical
+window/validity structure given the same shrink draws, identical batch
+packing for the subsample=0 stream, and the host-side words_done
+accounting. The corpus scan must be mesh-shape-invariant like every other
+engine path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus.batching import (
+    context_width, window_batch, window_offsets,
+)
+from glint_word2vec_tpu.ops.device_batching import (
+    WINDOW_FOLD, corpus_words_done, device_window_batch,
+)
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+V, D = 97, 16
+
+
+def _corpus(n_sent=7, lens=(5, 1, 9, 3, 12, 2, 6), seed=0):
+    rng = np.random.default_rng(seed)
+    sents = [rng.integers(0, V, L).astype(np.int32) for L in lens[:n_sent]]
+    ids = np.concatenate(sents)
+    offsets = np.zeros(len(sents) + 1, np.int64)
+    np.cumsum([len(s) for s in sents], out=offsets[1:])
+    return ids, offsets, sents
+
+
+def _device_b(key, rows, window):
+    """The shrink draws device_window_batch makes for these rows."""
+    base = jax.random.fold_in(key, WINDOW_FOLD)
+    keys = jax.vmap(lambda r: jax.random.fold_in(base, r))(rows)
+    return np.asarray(
+        jax.vmap(
+            lambda k: jax.random.randint(k, (), 0, window, dtype=jnp.int32)
+        )(keys)
+    )
+
+
+@pytest.mark.parametrize("window", [2, 3, 5])
+def test_device_window_batch_matches_host_semantics(window):
+    ids, offsets, _ = _corpus()
+    N = len(ids)
+    B = 8
+    key = jax.random.PRNGKey(7)
+    for start in range(0, N + B, B):
+        positions = jnp.arange(start, start + B, dtype=jnp.int32)
+        rows = jnp.arange(B, dtype=jnp.int32)
+        c, x, m = device_window_batch(
+            jnp.asarray(ids), jnp.asarray(offsets, jnp.int32),
+            positions, rows, key, window,
+        )
+        c, x, m = map(np.asarray, (c, x, m))
+        b = _device_b(key, rows, window)
+        offs = window_offsets(window)
+        C = context_width(window)
+        assert x.shape == (B, C) and m.shape == (B, C)
+        for i in range(B):
+            p = start + i
+            if p >= N:  # epoch tail: fully masked
+                assert c[i] == 0 and m[i].sum() == 0
+                continue
+            assert c[i] == ids[p]
+            j = np.searchsorted(offsets, p, side="right") - 1
+            s0, s1 = offsets[j], offsets[j + 1]
+            # Reference window rule (mllib:384-388 as restated in
+            # corpus/batching.py): offsets in [-b, b-1], in-sentence.
+            for lane in range(C):
+                o = offs[lane]
+                q = p + o
+                valid = (-b[i] <= o <= b[i] - 1) and s0 <= q < s1
+                assert m[i, lane] == (1.0 if valid else 0.0)
+                assert x[i, lane] == (ids[q] if valid else 0)
+
+
+def test_device_window_batch_equals_host_window_batch_given_same_b():
+    # Force identical shrink draws through both implementations: a
+    # single-sentence corpus, host window_batch with a stub rng whose
+    # integers() returns the device draws.
+    window = 4
+    ids, offsets, sents = _corpus(n_sent=1, lens=(14,))
+    key = jax.random.PRNGKey(3)
+    B = len(ids)
+    rows = jnp.arange(B, dtype=jnp.int32)
+    c, x, m = device_window_batch(
+        jnp.asarray(ids), jnp.asarray(offsets, jnp.int32),
+        jnp.arange(B, dtype=jnp.int32), rows, key, window,
+    )
+    b = _device_b(key, rows, window)
+
+    class StubRng:
+        def integers(self, lo, hi, size):
+            assert (lo, hi, size) == (0, window, B)
+            return b
+
+    hc, hx, hm = window_batch(ids, window, StubRng())
+    np.testing.assert_array_equal(np.asarray(c), hc)
+    np.testing.assert_array_equal(np.asarray(x), hx)
+    np.testing.assert_array_equal(np.asarray(m), hm)
+
+
+def test_corpus_words_done_matches_host_accounting():
+    ids, offsets, sents = _corpus()
+    # Host rule: a sentence counts fully once any of its positions is
+    # consumed (corpus/batching.py words_done).
+    assert corpus_words_done(offsets, 0) == 0
+    for end in range(1, len(ids) + 5):
+        e = min(end, len(ids))
+        j = np.searchsorted(offsets, e - 1, side="right") - 1
+        assert corpus_words_done(offsets, end) == offsets[j + 1]
+
+
+def _mk_engine(shape, V_, seed=11):
+    counts = np.arange(V_, 0, -1).astype(np.int64) * 3
+    return EmbeddingEngine(
+        make_mesh(*shape), V_, D, counts, num_negatives=3, seed=seed
+    )
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (4, 1)])
+def test_corpus_scan_mesh_invariance(shape):
+    # The corpus-resident scan must produce identical tables/losses on
+    # any mesh shape (same contract as train_steps).
+    ids, offsets, _ = _corpus()
+    ref = _mk_engine((1, 1), V)
+    eng = _mk_engine(shape, V)
+    key = jax.random.PRNGKey(5)
+    alphas = np.array([0.05, 0.04, 0.04, 0.03], np.float32)
+    for e in (ref, eng):
+        e.upload_corpus(ids, offsets)
+        e.train_steps_corpus(0, 8, 3, key, alphas, step0=2)
+    np.testing.assert_allclose(
+        np.asarray(eng.syn0, np.float32)[:V],
+        np.asarray(ref.syn0, np.float32)[:V],
+        rtol=2e-5, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(eng.syn1, np.float32)[:V],
+        np.asarray(ref.syn1, np.float32)[:V],
+        rtol=2e-5, atol=1e-7,
+    )
+
+
+def test_corpus_scan_tail_positions_are_noop():
+    # A scan dispatched entirely past the corpus end must not move the
+    # tables (all rows masked), matching zero-mask host padding.
+    ids, offsets, _ = _corpus()
+    eng = _mk_engine((1, 1), V)
+    eng.upload_corpus(ids, offsets)
+    s0 = np.asarray(eng.syn0, np.float32).copy()
+    s1 = np.asarray(eng.syn1, np.float32).copy()
+    eng.train_steps_corpus(
+        len(ids) + 64, 8, 3, jax.random.PRNGKey(0),
+        np.array([0.05, 0.05], np.float32),
+    )
+    np.testing.assert_array_equal(np.asarray(eng.syn0, np.float32), s0)
+    np.testing.assert_array_equal(np.asarray(eng.syn1, np.float32), s1)
+    # int32-wrapped (negative) positions must also be fully masked — a
+    # tail group near the 2**31 corpus bound wraps negative.
+    c, x, m = device_window_batch(
+        jnp.asarray(ids), jnp.asarray(offsets, jnp.int32),
+        jnp.arange(-8, 0, dtype=jnp.int32),
+        jnp.arange(8, dtype=jnp.int32), jax.random.PRNGKey(1), 3,
+    )
+    assert float(np.asarray(m).sum()) == 0.0
+    assert np.asarray(c).sum() == 0
+
+
+def test_upload_corpus_validates():
+    eng = _mk_engine((1, 1), V)
+    with pytest.raises(ValueError, match="offsets"):
+        eng.upload_corpus(
+            np.zeros(5, np.int32), np.array([0, 3], np.int64)
+        )
+    with pytest.raises(ValueError, match="no corpus uploaded"):
+        _mk_engine((1, 1), V).train_steps_corpus(
+            0, 8, 3, jax.random.PRNGKey(0), np.array([0.05], np.float32)
+        )
+
+
+# ---------------- model-level routing and end-to-end -------------------
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog".split(),
+    "the dog sleeps all day long in the sun".split(),
+    "a quick fox and a lazy dog meet in the field".split(),
+    "the sun rises over the field every day".split(),
+] * 30
+
+
+def _w2v(**kw):
+    from glint_word2vec_tpu import Word2Vec
+
+    defaults = dict(
+        vector_size=12, batch_size=32, min_count=1, num_iterations=2,
+        seed=7, steps_per_call=4, window=3,
+    )
+    defaults.update(kw)
+    return Word2Vec(**defaults)
+
+
+def test_fit_routes_to_device_corpus_and_trains():
+    model = _w2v().fit(CORPUS)
+    assert model.training_metrics["pipeline"] == "device_corpus"
+    assert model.training_metrics["steps"] > 0
+    # Trained-word accounting matches the host convention: all epochs'
+    # pre-subsampling words.
+    assert model.transform("quick").shape == (12,)
+    syn = model.find_synonyms("quick", 3)
+    assert len(syn) == 3
+
+
+def test_fit_subsampling_falls_back_to_host_pipeline():
+    model = _w2v(subsample_ratio=0.01).fit(CORPUS)
+    assert model.training_metrics["pipeline"] == "host"
+
+
+def test_fit_env_escape_hatch_forces_host(monkeypatch):
+    monkeypatch.setenv("GLINT_HOST_BATCHER", "1")
+    model = _w2v().fit(CORPUS)
+    assert model.training_metrics["pipeline"] == "host"
+
+
+def test_device_corpus_loss_decreases_and_quality_comparable():
+    # The device pipeline must LEARN like the host one: train both on
+    # the same corpus/schedule and compare final mean loss.
+    host = _w2v(num_iterations=3)
+    import os as _os
+
+    _os.environ["GLINT_HOST_BATCHER"] = "1"
+    try:
+        m_host = host.fit(CORPUS)
+    finally:
+        _os.environ.pop("GLINT_HOST_BATCHER", None)
+    m_dev = _w2v(num_iterations=3).fit(CORPUS)
+    lh = m_host.training_metrics["final_loss"]
+    ld = m_dev.training_metrics["final_loss"]
+    assert ld == pytest.approx(lh, rel=0.5), (ld, lh)
+    # Same trained-word accounting on both pipelines.
+    assert (
+        m_dev.training_metrics["words_done"]
+        == m_host.training_metrics["words_done"]
+    )
+
+
+def test_device_corpus_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "ck")
+    import os as _os
+
+    _os.makedirs(ck, exist_ok=True)
+    w = _w2v(num_iterations=3)
+    m1 = w.fit(CORPUS, checkpoint_dir=ck, stop_after_epochs=1)
+    assert m1.training_metrics["pipeline"] == "device_corpus"
+    m2 = _w2v(num_iterations=3).fit(CORPUS, checkpoint_dir=ck)
+    assert m2.training_metrics["pipeline"] == "device_corpus"
+    # Resumed run completed the remaining epochs and produces a model.
+    assert m2.training_metrics["steps"] > 0
+    assert len(m2.find_synonyms("dog", 2)) == 2

@@ -417,3 +417,42 @@ def test_negative_draws_slice_invariant_across_ranks(shape):
         )
         assert part.shape == (Bl, 3, 4)  # local rows only, no B_global
         np.testing.assert_array_equal(part, full[r * Bl : (r + 1) * Bl])
+
+
+def test_device_resident_inputs_no_host_bounce():
+    # Device-resident batches must be used in place: no device->host
+    # transfer anywhere in train_step/train_steps, and results identical
+    # to the numpy-input path. (A previous unconditional np.asarray
+    # bounced every jax.Array input through the host — a blocking D2H
+    # copy plus re-upload per dispatch.)
+    ref = _mk_engine(2, 2, seed=5)
+    eng = _mk_engine(2, 2, seed=5)
+    centers, contexts, mask = _batch(B=16)
+    key = jax.random.PRNGKey(11)
+
+    ref.train_step(centers, contexts, mask, key, 0.04)
+
+    dc, dx, dm = map(jax.device_put, (centers, contexts, mask))
+    with jax.transfer_guard_device_to_host("disallow"):
+        eng.train_step(dc, dx, dm, key, 0.04)
+    np.testing.assert_allclose(
+        np.asarray(eng.syn0, np.float32),
+        np.asarray(ref.syn0, np.float32),
+        rtol=1e-6,
+    )
+
+    K = 2
+    rng = np.random.default_rng(13)
+    ck = rng.integers(0, V, (K, 16)).astype(np.int32)
+    xk = rng.integers(0, V, (K, 16, 5)).astype(np.int32)
+    mk = (rng.random((K, 16, 5)) < 0.8).astype(np.float32)
+    al = np.full(K, 0.03, np.float32)
+    ref.train_steps(ck, xk, mk, key, al, 0)
+    dck, dxk, dmk = map(jax.device_put, (ck, xk, mk))
+    with jax.transfer_guard_device_to_host("disallow"):
+        eng.train_steps(dck, dxk, dmk, key, al, 0)
+    np.testing.assert_allclose(
+        np.asarray(eng.syn1, np.float32),
+        np.asarray(ref.syn1, np.float32),
+        rtol=1e-6,
+    )
