@@ -1035,10 +1035,10 @@ class EmbeddingEngine:
         host->device traffic drops to scalars. ~4 bytes/word of HBM,
         replicated per device."""
         n = int(np.asarray(ids).shape[0])
-        if n >= 2**31 or int(np.asarray(offsets)[-1]) != n:
+        if n < 1 or n >= 2**31 or int(np.asarray(offsets)[-1]) != n:
             raise ValueError(
-                "corpus must have offsets[-1] == len(ids) < 2**31 "
-                f"(got len(ids)={n})"
+                "corpus must be non-empty with offsets[-1] == len(ids) "
+                f"< 2**31 (got len(ids)={n})"
             )
         self._corpus = (
             jnp.asarray(ids, dtype=jnp.int32),

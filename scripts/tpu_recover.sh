@@ -8,7 +8,9 @@ cd "$(dirname "$0")/.." || exit 1
 echo "=== tpu_recover start $(date) ===" >> "$L"
 
 probe_alive() {
-  timeout 75 python - <<'EOF' >/dev/null 2>&1
+  # First device init over the tunnel can exceed 120s — a short timeout
+  # here would kill every probe mid-init and spin forever.
+  timeout 240 python - <<'EOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256))
 assert float((x @ x).sum()) > 0
