@@ -250,15 +250,12 @@ class Word2Vec:
         Common-Crawl-class configs (BASELINE.json): the reference gets the
         same property from Spark RDD streaming; a plain Python list of
         sentences costs ~15x more RAM than the flat encoding."""
-        from glint_word2vec_tpu.corpus.vocab import encode_file, iter_text_file
+        from glint_word2vec_tpu.corpus.vocab import scan_and_encode_file
 
         p = self.params
-        vocab = build_vocab(
-            iter_text_file(path, lowercase=lowercase), min_count=p.min_count
-        )
-        ids, offsets = encode_file(
-            path, vocab, max_sentence_length=p.max_sentence_length,
-            lowercase=lowercase,
+        vocab, ids, offsets = scan_and_encode_file(
+            path, min_count=p.min_count,
+            max_sentence_length=p.max_sentence_length, lowercase=lowercase,
         )
         pc, local_batch, steps_per_epoch = self._multihost_plan(np.diff(offsets))
         if pc == 1 and self._device_corpus_eligible():

@@ -97,6 +97,29 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.corpus_open.restype = ctypes.c_void_p
+        lib.corpus_open.argtypes = [ctypes.c_char_p]
+        lib.corpus_vocab_size.restype = ctypes.c_int64
+        lib.corpus_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.corpus_vocab_chars.restype = ctypes.c_int64
+        lib.corpus_vocab_chars.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.corpus_vocab_fill.restype = ctypes.c_int
+        lib.corpus_vocab_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.corpus_encode.restype = ctypes.c_int64
+        lib.corpus_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.corpus_encode_fill.restype = ctypes.c_int
+        lib.corpus_encode_fill.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.corpus_free.restype = None
+        lib.corpus_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -164,3 +187,75 @@ def window_batch_epoch_native(
     return (
         centers[:rows], contexts[:rows], mask[:rows], int(words_done.value)
     )
+
+
+def corpus_scan_native(
+    path: str,
+    min_count: int,
+    max_sentence_length: int,
+    lowercase: bool = False,
+) -> Optional[Tuple[list, np.ndarray, np.ndarray, np.ndarray]]:
+    """Native fit_file ingestion: both corpus passes (vocab count + flat
+    encode) in C++, one file handle each.
+
+    Returns ``(words, counts int64[n], ids int32[total], offsets
+    int64[n_sentences+1])`` — the inputs ``Vocabulary`` + the flat corpus
+    representation are built from — or None when the caller should use the
+    Python path instead: native library unavailable, file unreadable,
+    invalid UTF-8 anywhere in the corpus (Python's errors='replace' decode
+    merges tokens differing only in invalid bytes — byte-level counting
+    cannot reproduce that), or ``lowercase`` requested (``str.lower`` is
+    Unicode-aware). An empty vocab returns empty arrays; the caller
+    decides whether that is an error (``Vocabulary.from_sorted`` raises).
+
+    Token/tie-break semantics match corpus/vocab.py exactly for valid
+    UTF-8 corpora: full str.split() whitespace set, universal-newline
+    sentence boundaries, count desc, first-seen order on ties, OOV
+    dropped, per-line chunking at ``max_sentence_length`` (equality is
+    unit-tested against the Python passes).
+    """
+    if lowercase:
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    h = lib.corpus_open(os.fsencode(path))
+    if not h:
+        return None
+    try:
+        n = int(lib.corpus_vocab_size(h, min_count))
+        if n <= 0:
+            return (
+                [], np.zeros(0, np.int64), np.zeros(0, np.int32),
+                np.zeros(1, np.int64),
+            )
+        nchars = int(lib.corpus_vocab_chars(h, min_count))
+        chars = ctypes.create_string_buffer(max(nchars, 1))
+        offs = np.empty(n + 1, dtype=np.int64)
+        counts = np.empty(n, dtype=np.int64)
+        lib.corpus_vocab_fill(
+            h, min_count, chars, _ptr(offs, ctypes.c_int64),
+            _ptr(counts, ctypes.c_int64),
+        )
+        raw = chars.raw[:nchars]
+        bounds = offs.tolist()
+        words = [
+            raw[bounds[i]:bounds[i + 1]].decode("utf-8", errors="replace")
+            for i in range(n)
+        ]
+        n_sent = ctypes.c_int64(0)
+        n_ids = int(
+            lib.corpus_encode(
+                h, min_count, max_sentence_length, ctypes.byref(n_sent)
+            )
+        )
+        if n_ids < 0:
+            return None
+        ids = np.empty(max(n_ids, 1), dtype=np.int32)[:n_ids]
+        soffs = np.empty(int(n_sent.value) + 1, dtype=np.int64)
+        lib.corpus_encode_fill(
+            h, _ptr(ids, ctypes.c_int32), _ptr(soffs, ctypes.c_int64)
+        )
+        return words, counts, ids, soffs
+    finally:
+        lib.corpus_free(h)

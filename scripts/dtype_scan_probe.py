@@ -1,0 +1,131 @@
+"""Sustained device-input scan timings per dtype config and scan length.
+
+Round-4 follow-up to scan_scatter_probe.py, which showed (a) the
+"bf16 tables are 2.3-3.7x slower" finding was measured through
+numpy-input scans whose timings swing 3x call-to-call (tunnel transfer
+noise), and (b) isolated micros put bf16 scatter at parity with f32 and
+bf16 gather 8.5x faster — so the regression claim needs a clean retest.
+
+This probe measures what bench.py's production path measures — the
+scanned train step with DEVICE-RESIDENT inputs — but in a sustained
+timed loop (>= SUSTAIN_S seconds per cell, default 2) so short-burst
+clock effects don't flatter small scan lengths, across:
+
+  dtype configs: f32 tables, bf16 tables (+f32 compute), bf16 tables+compute
+  scan lengths:  spc in {4, 16, 32}
+  estimators:    per_pair for the grid; shared-pool at spc=16 for the
+                 two interesting dtypes
+
+Usage: python scripts/dtype_scan_probe.py [--out FILE]
+Knobs: PROBE_SUSTAIN_S, PROBE_SPCS, PROBE_VOCAB, GLINT_PROFILE_PLATFORM.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_PROFILE_PLATFORM"))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+V = int(os.environ.get("PROBE_VOCAB", 1_000_000))
+d, B, C, n = 300, 8192, 7, 5
+SUSTAIN_S = float(os.environ.get("PROBE_SUSTAIN_S", 2.0))
+SPCS = tuple(
+    int(s) for s in os.environ.get("PROBE_SPCS", "4,16,32").split(",")
+)
+
+CONFIGS = (
+    ("f32", dict(dtype="float32")),
+    ("bf16t", dict(dtype="bfloat16")),
+    ("bf16ct", dict(dtype="bfloat16", compute_dtype="bfloat16")),
+)
+
+
+def sustained_us_per_step(fn, spc):
+    """Wall time per scan step over a >= SUSTAIN_S timed window.
+
+    One untimed call first (compile + clock warm), then as many timed
+    calls as the window needs; block only on the last result so dispatch
+    pipelining matches the production training loop.
+    """
+    jax.block_until_ready(fn(0))
+    t0 = time.perf_counter()
+    calls, last = 0, None
+    while True:
+        last = fn(calls + 1)
+        calls += 1
+        if calls >= 2 and time.perf_counter() - t0 >= SUSTAIN_S:
+            break
+    jax.block_until_ready(last)
+    dt = time.perf_counter() - t0
+    return round(dt / (calls * spc) * 1e6, 1), calls
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/dtype_scan_probe.json")
+    args = ap.parse_args()
+
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    res = {"device": str(jax.devices()[0]), "sustain_s": SUSTAIN_S,
+           "vocab": V, "dim": d, "batch": B}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=2)
+
+    mesh = make_mesh(1, 1, devices=[jax.devices()[0]])
+    ranks = np.arange(1, V + 1, dtype=np.float64)
+    counts = np.maximum(1e9 / ranks, 1.0).astype(np.int64)
+    p = counts / counts.sum()
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+
+    def cell(tag, spc, shared=0, **kw):
+        eng = EmbeddingEngine(
+            mesh, V, d, counts, num_negatives=n, seed=0,
+            shared_negatives=shared, **kw,
+        )
+        ck = jax.device_put(
+            rng.choice(V, size=(spc, B), p=p).astype(np.int32)
+        )
+        xk = jax.device_put(
+            rng.choice(V, size=(spc, B, C), p=p).astype(np.int32)
+        )
+        mk = jax.device_put(
+            (rng.random((spc, B, C)) < 0.85).astype(np.float32)
+        )
+        al = jax.device_put(np.full(spc, 0.025, np.float32))
+        jax.block_until_ready(al)
+        us, calls = sustained_us_per_step(
+            lambda i: eng.train_steps(ck, xk, mk, key, al, i * spc), spc
+        )
+        res[tag] = {"us_per_step": us,
+                    "words_per_sec": round(B / (us * 1e-6), 1),
+                    "timed_calls": calls}
+        print(f"[probe] {tag}: {us} us/step "
+              f"({res[tag]['words_per_sec']:.3g} w/s)", file=sys.stderr)
+        del eng, ck, xk, mk, al
+        flush()
+
+    for name, kw in CONFIGS:
+        for spc in SPCS:
+            cell(f"per_pair_{name}_spc{spc}", spc, **kw)
+    for name, kw in (CONFIGS[0], CONFIGS[2]):
+        cell(f"shared_{name}_spc16", 16, shared=4096, **kw)
+
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,6 +126,50 @@ def main():
         "overlap_gain": round(direct / pre, 3) if pre > 0 else None,
     }
 
+    # File-ingestion passes (fit_file's two corpus scans): native C++
+    # scanner vs the pure-Python passes. This was the end-to-end wall
+    # dominator before the native scanner existed (~1M words/s in Python).
+    ingest_words = int(os.environ.get("HOSTPATH_INGEST_WORDS", 5_000_000))
+    import tempfile
+
+    from glint_word2vec_tpu.corpus.vocab import (
+        build_vocab, encode_file, iter_text_file,
+    )
+    from glint_word2vec_tpu.native import corpus_scan_native
+
+    print("[hostpath] writing ingest corpus...", file=sys.stderr, flush=True)
+    iid = ids[:ingest_words]
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", delete=False
+    ) as tf:
+        corpus_path = tf.name
+        for s in range(0, iid.size, sent_len):
+            tf.write(" ".join(f"w{i}" for i in iid[s : s + sent_len]))
+            tf.write("\n")
+    try:
+        n_words = int(iid.size)
+        t0 = time.perf_counter()
+        nat = corpus_scan_native(corpus_path, 1, 1000)
+        dt_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pv = build_vocab(iter_text_file(corpus_path), min_count=1)
+        _ = encode_file(corpus_path, pv, max_sentence_length=1000)
+        dt_python = time.perf_counter() - t0
+        res["file_ingest"] = {
+            "corpus_words": n_words,
+            "native_available": nat is not None,
+            "native_seconds": (
+                round(dt_native, 2) if nat is not None else None
+            ),
+            "native_words_per_sec": (
+                round(n_words / dt_native, 1) if nat is not None else None
+            ),
+            "python_seconds": round(dt_python, 2),
+            "python_words_per_sec": round(n_words / dt_python, 1),
+        }
+    finally:
+        os.unlink(corpus_path)
+
     out = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "HOSTPATH.json",

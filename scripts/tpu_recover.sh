@@ -1,7 +1,9 @@
 #!/bin/bash
 # Tunnel-recovery watcher: poll until the chip answers a tiny op, then run
-# the round-4 measurement queue in priority order. Safe to leave running;
-# exits after one full pass. Log: /tmp/tpu_recover.log
+# the round-4 measurement queue in priority order, re-probing aliveness
+# between stages so a mid-queue tunnel death doesn't burn every later
+# stage's timeout against a dead link. Safe to leave running; exits after
+# one full pass. Log: /tmp/tpu_recover.log
 set -u
 L="${1:-/tmp/tpu_recover.log}"
 cd "$(dirname "$0")/.." || exit 1
@@ -17,24 +19,33 @@ assert float((x @ x).sum()) > 0
 EOF
 }
 
-until probe_alive; do
-  echo "chip unreachable $(date)" >> "$L"
-  sleep 120
-done
-echo "chip ALIVE $(date) — running queue" >> "$L"
+wait_alive() {
+  until probe_alive; do
+    echo "chip unreachable $(date)" >> "$L"
+    sleep 120
+  done
+  echo "chip ALIVE $(date)" >> "$L"
+}
 
-echo "--- scan_scatter_probe" >> "$L"
-timeout 900 python scripts/scan_scatter_probe.py \
-  --out /tmp/scan_scatter_probe.json >> "$L" 2>&1
-echo "probe rc=$?" >> "$L"
+stage() {  # stage NAME TIMEOUT CMD...
+  local name="$1" to="$2"; shift 2
+  wait_alive
+  echo "--- $name $(date)" >> "$L"
+  timeout "$to" "$@" >> "$L" 2>&1
+  echo "$name rc=$?" >> "$L"
+}
 
-echo "--- scale_test (perf d=300 + gate d=100)" >> "$L"
-timeout 1800 python scripts/scale_test.py > /tmp/scale_tpu2.json 2>>"$L"
-echo "scale rc=$?" >> "$L"
+stage dtype_scan_probe 1200 \
+  python scripts/dtype_scan_probe.py --out /tmp/dtype_scan_probe.json
 
-echo "--- fit_file_bench (10M words)" >> "$L"
-FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
-  timeout 1500 python scripts/fit_file_bench.py > /tmp/fitfile_tpu.json 2>>"$L"
-echo "fitfile rc=$?" >> "$L"
+stage bench 900 \
+  bash -c 'python bench.py > /tmp/bench_tpu2.json'
+
+stage scale_test 1800 \
+  bash -c 'python scripts/scale_test.py > /tmp/scale_tpu2.json'
+
+stage fit_file_bench 1500 \
+  env FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
+  bash -c 'python scripts/fit_file_bench.py > /tmp/fitfile_tpu.json'
 
 echo "=== tpu_recover done $(date) ===" >> "$L"

@@ -145,3 +145,151 @@ def test_native_alias_large_vocab_fast():
     dt = time.time() - t0
     assert dt < 2.0, f"native alias build too slow: {dt:.1f}s at 1M vocab"
     assert prob.shape == (1_000_000,)
+
+
+class TestCorpusScanner:
+    """Native fit_file ingestion (corpus_open/encode) vs the Python passes."""
+
+    CORPUS = (
+        "the quick brown fox jumps over the lazy dog\n"
+        "the the the\n"
+        "tie1 tie2 tie1 tie2 tie1 tie2\n"
+        "\n"
+        "   \n"
+        "singleton   words\twith\ttabs   here\n"
+        + ("a b c " * 400)
+        + "\n"
+        + "trailing no newline"
+    )
+
+    @pytest.fixture()
+    def corpus_path(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text(self.CORPUS, encoding="utf-8")
+        return str(p)
+
+    @pytest.mark.parametrize(
+        "min_count,max_len", [(1, 1000), (2, 1000), (1, 7), (3, 2)]
+    )
+    def test_native_matches_python_passes(self, corpus_path, min_count,
+                                          max_len):
+        from glint_word2vec_tpu.corpus.vocab import (
+            build_vocab, encode_file, iter_text_file,
+        )
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        res = corpus_scan_native(corpus_path, min_count, max_len)
+        assert res is not None
+        words, counts, ids, offsets = res
+        vocab = build_vocab(
+            iter_text_file(corpus_path), min_count=min_count
+        )
+        ids_py, offs_py = encode_file(
+            corpus_path, vocab, max_sentence_length=max_len
+        )
+        assert words == vocab.words  # count desc, first-seen tie order
+        np.testing.assert_array_equal(counts, vocab.counts)
+        np.testing.assert_array_equal(ids, ids_py)
+        np.testing.assert_array_equal(offsets, offs_py)
+
+    def test_scan_and_encode_file_dispatcher(self, corpus_path):
+        """The dispatcher returns identical results whichever path runs."""
+        from glint_word2vec_tpu.corpus.vocab import scan_and_encode_file
+
+        vocab, ids, offsets = scan_and_encode_file(
+            corpus_path, min_count=1, max_sentence_length=1000
+        )
+        assert vocab.words[0] == "a"  # 1200 occurrences, most frequent
+        assert vocab.train_words_count == int(vocab.counts.sum())
+        assert ids.dtype == np.int32 and offsets.dtype == np.int64
+        assert offsets[-1] == ids.size
+        # Lowercase requests must take the (Unicode-aware) Python path and
+        # still produce the same structure.
+        v2, i2, o2 = scan_and_encode_file(
+            corpus_path, min_count=1, max_sentence_length=1000,
+            lowercase=True,
+        )
+        assert v2.words[0] == "a"
+        np.testing.assert_array_equal(o2, offsets)
+
+    def test_empty_vocab_raises_via_dispatcher(self, tmp_path):
+        from glint_word2vec_tpu.corpus.vocab import scan_and_encode_file
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        p = tmp_path / "tiny.txt"
+        p.write_text("one two three\n", encoding="utf-8")
+        words, counts, ids, offs = corpus_scan_native(str(p), 5, 1000)
+        assert words == [] and ids.size == 0 and offs.tolist() == [0]
+        with pytest.raises(ValueError, match="vocabulary size"):
+            scan_and_encode_file(str(p), min_count=5)
+
+    def test_missing_file_returns_none(self):
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        assert corpus_scan_native("/nonexistent/x.txt", 1, 1000) is None
+
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "a b\rc d\re f",          # lone-\r line endings
+            "a b\r\nc d\r\ne",        # \r\n line endings
+            "x y z w\n",    # NBSP + EM SPACE separators
+            "one　two threefour\n",  # CJK space, LS, NEL
+            "tok end\r\rmid\n\n",
+            "x\u1680y\u202fz\u205fw\u200aq\n",  # OGHAM, NNBSP, MMSP, HAIR
+        ],
+    )
+    def test_unicode_whitespace_and_newlines_match_python(
+        self, tmp_path, text
+    ):
+        from glint_word2vec_tpu.corpus.vocab import (
+            build_vocab, encode_file, iter_text_file,
+        )
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        p = tmp_path / "ws.txt"
+        p.write_text(text, encoding="utf-8")
+        res = corpus_scan_native(str(p), 1, 1000)
+        assert res is not None
+        words, counts, ids, offsets = res
+        vocab = build_vocab(iter_text_file(str(p)), min_count=1)
+        ids_py, offs_py = encode_file(str(p), vocab, max_sentence_length=1000)
+        assert words == vocab.words
+        np.testing.assert_array_equal(counts, vocab.counts)
+        np.testing.assert_array_equal(ids, ids_py)
+        np.testing.assert_array_equal(offsets, offs_py)
+
+    def test_invalid_utf8_falls_back_to_python(self, tmp_path):
+        """Bytes Python would errors='replace'-merge make the native
+        scanner decline, so the dispatcher's result always matches the
+        Python semantics."""
+        from glint_word2vec_tpu.corpus.vocab import (
+            build_vocab, iter_text_file, scan_and_encode_file,
+        )
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        p = tmp_path / "bad.txt"
+        p.write_bytes(b"a\xff b\xfe a\xff valid word word\n")
+        assert corpus_scan_native(str(p), 1, 1000) is None
+        vocab, ids, offs = scan_and_encode_file(str(p), min_count=1)
+        ref = build_vocab(iter_text_file(str(p)), min_count=1)
+        assert vocab.words == ref.words  # a� and b� merged order
+        assert offs[-1] == ids.size
+
+    def test_utf8_words_roundtrip(self, tmp_path):
+        from glint_word2vec_tpu.corpus.vocab import (
+            build_vocab, iter_text_file,
+        )
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        p = tmp_path / "de.txt"
+        p.write_text(
+            "österreich wien österreich grüße\nwien österreich\n",
+            encoding="utf-8",
+        )
+        res = corpus_scan_native(str(p), 1, 1000)
+        assert res is not None
+        words, counts, _, _ = res
+        vocab = build_vocab(iter_text_file(str(p)), min_count=1)
+        assert words == vocab.words
+        np.testing.assert_array_equal(counts, vocab.counts)
