@@ -283,9 +283,10 @@ struct Corpus {
     std::string path;
     std::unordered_map<std::string, Ent> tab;
     // Token stream as provisional (first-seen) ids + raw line lengths,
-    // recorded during the counting pass.
+    // recorded during the counting pass; freed by corpus_encode (one-shot).
     std::vector<int32_t> prov;
     std::vector<int64_t> prov_lens;
+    bool prov_consumed = false;
     // Sorted vocab cache for the min_count last queried.
     int64_t cached_min = -1;
     std::vector<std::pair<const std::string*, const Ent*>> sorted;
@@ -467,10 +468,17 @@ int corpus_vocab_fill(void* h, int64_t min_count, char* chars, int64_t* offs,
 // sentences = lines chunked at max_sentence_length, empty sentences
 // dropped. Returns the total id count (query sentence count via
 // *n_sentences_out), or -1 on bad input.
+//
+// ONE-SHOT per handle: the provisional stream (4 B/corpus word) is freed
+// here — its last use — so the handle never holds the provisional stream,
+// the encode output, and the hashmap at once (fit_file's host-memory
+// promise is ~4 B/kept word; keeping all three would triple the peak on
+// web-scale corpora). A second call returns -1.
 int64_t corpus_encode(void* h, int64_t min_count, int64_t max_sentence_length,
                       int64_t* n_sentences_out) {
     auto* c = static_cast<Corpus*>(h);
     if (max_sentence_length <= 0) return -1;
+    if (c->prov_consumed) return -1;
     ensure_sorted(c, min_count);
     // remap[provisional first-seen id] -> frequency rank, or -1 (dropped).
     std::vector<int32_t> remap(c->tab.size(), -1);
@@ -497,13 +505,18 @@ int64_t corpus_encode(void* h, int64_t min_count, int64_t max_sentence_length,
             kept -= take;
         }
     }
+    c->prov_consumed = true;
+    std::vector<int32_t>().swap(c->prov);
+    std::vector<int64_t>().swap(c->prov_lens);
     if (n_sentences_out)
         *n_sentences_out = static_cast<int64_t>(c->enc_lens.size());
     return static_cast<int64_t>(c->enc_ids.size());
 }
 
 // Copies the corpus_encode results into caller-allocated `ids`
-// (int32[n_ids]) and sentence offsets `soffs` (int64[n_sentences+1]).
+// (int32[n_ids]) and sentence offsets `soffs` (int64[n_sentences+1]),
+// then frees the internal buffers (one-shot, like corpus_encode): after
+// this call the caller's numpy arrays are the only copy.
 int corpus_encode_fill(void* h, int32_t* ids, int64_t* soffs) {
     auto* c = static_cast<Corpus*>(h);
     if (!c->enc_ids.empty())
@@ -515,6 +528,8 @@ int corpus_encode_fill(void* h, int32_t* ids, int64_t* soffs) {
         pos += c->enc_lens[i];
         soffs[i + 1] = pos;
     }
+    std::vector<int32_t>().swap(c->enc_ids);
+    std::vector<int64_t>().swap(c->enc_lens);
     return 0;
 }
 

@@ -649,6 +649,7 @@ class EmbeddingEngine:
 
         num_data = self.num_data
         self._corpus_scan_cache: dict = {}
+        self._ones_mask_cache: dict = {}
 
         def make_corpus_scan(B: int, W: int):
             # Corpus-resident scan: batches are assembled ON DEVICE from
@@ -918,10 +919,18 @@ class EmbeddingEngine:
         (jax) arrays; device arrays are used in place — no host bounce.
         """
         centers = _host_or_device(centers)
+        B = centers.shape[0]
+        # Same device-resident cached mask trick as train_steps: never
+        # re-upload a constant per call (multi-host wants host arrays).
+        if jax.process_count() > 1:
+            gm = np.ones((B, 1), dtype=np.float32)
+        else:
+            if self._ones_mask_cache.get("key1") != B:
+                self._ones_mask_cache["key1"] = B
+                self._ones_mask_cache["val1"] = jnp.ones((B, 1), jnp.float32)
+            gm = self._ones_mask_cache["val1"]
         return self.train_step_grouped(
-            centers[:, None],
-            np.ones((centers.shape[0], 1), dtype=np.float32),
-            contexts, mask, key, alpha,
+            centers[:, None], gm, contexts, mask, key, alpha,
         )
 
     def _device_batch(self, *arrays, data_axis: int):
@@ -987,9 +996,24 @@ class EmbeddingEngine:
         """
         centers_k = _host_or_device(centers_k)
         K, B = centers_k.shape[0], centers_k.shape[1]
+        # Device-resident all-ones group mask, cached per shape: building
+        # it as host numpy per call re-uploaded ~32 KB/step of constant
+        # data every dispatch, contaminating the "only scalars cross per
+        # dispatch" property of the device-resident hot path.
+        if jax.process_count() > 1:
+            # Multi-host assembles global batches from HOST arrays
+            # (make_global_batch); a device-resident constant would bounce
+            # device->host per call there.
+            gm = np.ones((K, B, 1), dtype=np.float32)
+        else:
+            if self._ones_mask_cache.get("keyK") != (K, B):
+                self._ones_mask_cache["keyK"] = (K, B)
+                self._ones_mask_cache["valK"] = jnp.ones(
+                    (K, B, 1), jnp.float32
+                )
+            gm = self._ones_mask_cache["valK"]
         return self.train_steps_grouped(
-            centers_k[:, :, None],
-            np.ones((K, B, 1), dtype=np.float32),
+            centers_k[:, :, None], gm,
             contexts_k, mask_k, base_key, alphas, step0,
         )
 

@@ -48,4 +48,6 @@ stage fit_file_bench 1500 \
   env FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
   bash -c 'python scripts/fit_file_bench.py > /tmp/fitfile_tpu.json'
 
+stage bench_sweep 2400 python scripts/bench_sweep.py
+
 echo "=== tpu_recover done $(date) ===" >> "$L"
