@@ -69,7 +69,7 @@ class FastTextWord2Vec(Word2Vec):
 
     # Family hooks -----------------------------------------------------
 
-    def _device_corpus_eligible(self) -> bool:
+    def _device_corpus_eligible(self, corpus_words: int = 0) -> bool:
         # Subword centers need the host-side group expansion
         # (_train_batches below); the device corpus batcher assembles
         # word-level centers only.

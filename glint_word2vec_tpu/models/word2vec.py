@@ -205,7 +205,7 @@ class Word2Vec:
         )
         lens = np.array([s.size for s in encoded], dtype=np.int64)
         pc, local_batch, steps_per_epoch = self._multihost_plan(lens)
-        if pc == 1 and self._device_corpus_eligible():
+        if pc == 1 and self._device_corpus_eligible(int(lens.sum())):
             # encode_sentences already yields int32; copy=False avoids a
             # second full-corpus copy at peak host-memory time.
             ids = (
@@ -258,7 +258,7 @@ class Word2Vec:
             max_sentence_length=p.max_sentence_length, lowercase=lowercase,
         )
         pc, local_batch, steps_per_epoch = self._multihost_plan(np.diff(offsets))
-        if pc == 1 and self._device_corpus_eligible():
+        if pc == 1 and self._device_corpus_eligible(int(ids.size)):
             return self._fit_corpus_resident(
                 vocab, ids, offsets, checkpoint_dir,
                 checkpoint_every_epochs, stop_after_epochs,
@@ -279,15 +279,22 @@ class Word2Vec:
             stop_after_epochs, steps_per_epoch=steps_per_epoch,
         )
 
-    def _device_corpus_eligible(self) -> bool:
+    def _device_corpus_eligible(self, corpus_words: int = 0) -> bool:
         """Whether the device-resident corpus path applies: word-level
         centers (subword grouping overrides this to False), no frequency
         subsampling (it compacts sentences before windowing — a dynamic
         reshape the static-shape device batcher does not express; see
-        ops/device_batching), and no env escape hatch. Single-process
-        only — the caller checks process count."""
+        ops/device_batching), the corpus fits the HBM budget reserved
+        for it (4 bytes/word replicated per device; tables need the
+        rest — GLINT_DEVICE_CORPUS_MAX_BYTES overrides the 2 GiB
+        default), and no env escape hatch. Single-process only — the
+        caller checks process count."""
+        budget = int(
+            os.environ.get("GLINT_DEVICE_CORPUS_MAX_BYTES", 2 << 30)
+        )
         return (
             self.params.subsample_ratio == 0.0
+            and 4 * corpus_words <= budget
             and os.environ.get("GLINT_HOST_BATCHER", "0") != "1"
         )
 

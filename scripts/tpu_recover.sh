@@ -50,4 +50,7 @@ stage fit_file_bench 1500 \
 
 stage bench_sweep 2400 python scripts/bench_sweep.py
 
+stage pallas_retry 600 \
+  bash -c 'python scripts/pallas_bench.py > /tmp/pallas_tpu.json'
+
 echo "=== tpu_recover done $(date) ===" >> "$L"

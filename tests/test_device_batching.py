@@ -263,3 +263,16 @@ def test_device_corpus_checkpoint_resume(tmp_path):
     # Resumed run completed the remaining epochs and produces a model.
     assert m2.training_metrics["steps"] > 0
     assert len(m2.find_synonyms("dog", 2)) == 2
+
+
+def test_device_corpus_routing_respects_hbm_budget(monkeypatch):
+    """A corpus larger than the device-corpus HBM budget must route to the
+    host batcher even when otherwise eligible (subsample off, 1 process)."""
+    from glint_word2vec_tpu.models.word2vec import Word2Vec
+
+    m = Word2Vec(subsample_ratio=0.0)
+    assert m._device_corpus_eligible(1000)
+    assert not m._device_corpus_eligible((2 << 30) // 4 + 1)
+    monkeypatch.setenv("GLINT_DEVICE_CORPUS_MAX_BYTES", "4000")
+    assert m._device_corpus_eligible(1000)
+    assert not m._device_corpus_eligible(1001)
