@@ -33,6 +33,9 @@ class TrainingMetrics:
     host_time: float = 0.0  # seconds spent producing batches
     step_time: float = 0.0  # seconds spent in train-step dispatch
     last_loss: Optional[float] = None
+    #: Most recent per-step loss as an UNSYNCED device array; float()ed
+    #: only at log points and in summary().
+    _last_loss_lazy: Optional[object] = None
     _t_start: float = field(default_factory=time.time)
     _t_window: float = field(default_factory=time.time)
     _words_window: int = -1  # sentinel: initialized on first record_step
@@ -45,6 +48,11 @@ class TrainingMetrics:
     def record_step(self, words_done: int, loss=None, alpha=None) -> None:
         self.steps += 1
         self.words_done = words_done
+        if loss is not None:
+            # Keep the device array without forcing a sync: float() blocks
+            # the dispatch pipeline, so it happens only at log points and
+            # in summary() — never per step.
+            self._last_loss_lazy = loss
         if self.steps % self.log_every == 0:
             now = time.time()
             wps = (words_done - self._words_window) / max(now - self._t_window, 1e-9)
@@ -81,6 +89,10 @@ class TrainingMetrics:
 
     def summary(self) -> dict:
         wall = max(time.time() - self._t_start, 1e-9)
+        if self._last_loss_lazy is not None:
+            # One sync at summary time so short runs (fewer than log_every
+            # steps) still report a final loss.
+            self.last_loss = float(self._last_loss_lazy)
         return {
             "steps": self.steps,
             "words_done": self.words_done,
