@@ -51,7 +51,7 @@ def _is_fresh() -> bool:
 def _build() -> bool:
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        _SRC, "-o", _SO,
+        "-pthread", _SRC, "-o", _SO,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -95,7 +95,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
             ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
         ]
         lib.corpus_open.restype = ctypes.c_void_p
         lib.corpus_open.argtypes = [ctypes.c_char_p]
@@ -157,15 +157,24 @@ def window_batch_epoch_native(
     keep_prob: np.ndarray,
     window: int,
     seed: int,
+    threads: Optional[int] = None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
-    """Run a full subsample+window epoch pass natively.
+    """Run a full subsample+window epoch pass natively, parallel across
+    sentence chunks. The output is byte-identical for every thread count
+    (deterministic per-sentence PRNG seeds + a two-phase count/fill).
 
-    Returns (centers, contexts, mask, words_done) with exactly the kept rows,
-    or None if the native library is unavailable.
+    ``threads``: worker count; None reads GLINT_NATIVE_THREADS, else 0 =
+    one per hardware core. Returns (centers, contexts, mask, words_done)
+    with exactly the kept rows, or None if the library is unavailable.
     """
     lib = get_lib()
     if lib is None:
         return None
+    if threads is None:
+        try:
+            threads = int(os.environ.get("GLINT_NATIVE_THREADS", "0"))
+        except ValueError:  # empty/non-numeric: hardware default
+            threads = 0
     C = max(1, 2 * int(window) - 3)
     ids_c = np.ascontiguousarray(ids, dtype=np.int32)
     off_c = np.ascontiguousarray(offsets, dtype=np.int64)
@@ -180,7 +189,7 @@ def window_batch_epoch_native(
         off_c.size - 1, _ptr(kp_c, ctypes.c_float), int(window),
         ctypes.c_uint64(seed & (2**64 - 1)), _ptr(centers, ctypes.c_int32),
         _ptr(contexts, ctypes.c_int32), _ptr(mask, ctypes.c_float),
-        cap, ctypes.byref(words_done),
+        cap, ctypes.byref(words_done), int(threads),
     )
     if rows < 0:  # capacity == total ids, so this cannot happen
         raise RuntimeError("window_batch_epoch capacity overflow")

@@ -9,12 +9,13 @@
 //   1. alias_build    — O(V) Walker alias-table construction (the Python
 //                       two-pointer loop takes minutes at 10M vocab).
 //   2. window_batch   — per-epoch subsample + shrunk-window context/mask
-//                       generation. Measured on the build host
-//                       (scripts/host_path_bench.py -> HOSTPATH.json,
-//                       20M-word Zipf corpus, 1M vocab, B=8192): 10.4M
-//                       center positions/s (no subsample), 15.6M/s at
-//                       subsample 1e-4; the Python/NumPy fallback pass
-//                       measures 0.99M/s on the same corpus.
+//                       generation, thread-parallel across sentence
+//                       chunks (output invariant to thread count).
+//                       Measured numbers live in HOSTPATH.json
+//                       (scripts/host_path_bench.py): ~15M center
+//                       positions/s single-threaded on the 1-core build
+//                       host vs ~0.85M/s for the Python fallback;
+//                       multi-core feeder hosts scale with threads.
 //
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 // All buffers are caller-allocated NumPy arrays; nothing here allocates
@@ -26,6 +27,7 @@
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -105,8 +107,14 @@ struct Rng {
     }
 };
 
+}  // extern "C"
+
 // One epoch pass over a flattened corpus: frequency subsampling + shrunk-
-// window context generation, emitting fixed-width rows.
+// window context generation, emitting fixed-width rows. Parallelized
+// across sentences with a deterministic two-phase scheme — per-sentence
+// PRNG seeds make the output BYTE-IDENTICAL for every thread count
+// (phase 1 counts kept rows per sentence chunk, a prefix sum fixes each
+// chunk's output offset, phase 2 re-derives the same draws and fills).
 //
 // Inputs:
 //   ids        — concatenated sentence word-indices, int32[total_len]
@@ -115,6 +123,7 @@ struct Rng {
 //   window     — reference windowSize; per position draw b in [0, window)
 //                and take offsets [-b, b-1] \ {0} (mllib:384-388)
 //   seed       — epoch seed (caller mixes epoch index)
+//   threads    — worker count; <=0 picks hardware_concurrency
 // Outputs (caller-allocated, capacity rows >= total_len):
 //   centers    — int32[capacity]
 //   contexts   — int32[capacity * ctx_width]   (ctx_width = 2*window - 3,
@@ -122,53 +131,153 @@ struct Rng {
 //   mask       — float32[capacity * ctx_width]
 // Returns the number of rows written (= number of kept word positions), or
 // -1 if capacity was insufficient.
+
+namespace {
+
+inline uint64_t sentence_seed(uint64_t seed, int64_t s) {
+    // splitmix64 over (seed, sentence index): independent per-sentence
+    // streams, stable across thread counts.
+    uint64_t z = seed + 0x9E3779B97f4A7C15ULL * static_cast<uint64_t>(s + 1);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+// Number of kept positions in sentence s (phase-1 counting: consumes the
+// same subsample draws phase 2 will).
+inline int64_t count_kept(const int32_t* ids, int64_t beg, int64_t end,
+                          const float* keep_prob, uint64_t sseed) {
+    Rng rng(sseed);
+    int64_t kept = 0;
+    for (int64_t i = beg; i < end; ++i) {
+        const float kp = keep_prob[ids[i]];
+        if (kp >= 1.0f || rng.next_double() <= kp) ++kept;
+    }
+    return kept;
+}
+
+// Fill rows for sentence s starting at output row `row`; returns rows
+// written. Draw order matches count_kept: all subsample draws first,
+// then one b draw per kept position.
+inline int64_t fill_sentence(const int32_t* ids, int64_t beg, int64_t end,
+                             const float* keep_prob, uint64_t sseed,
+                             int64_t W, int64_t C, int64_t row,
+                             int32_t* centers, int32_t* contexts,
+                             float* mask, std::vector<int32_t>& kept) {
+    Rng rng(sseed);
+    kept.clear();
+    for (int64_t i = beg; i < end; ++i) {
+        const int32_t w = ids[i];
+        const float kp = keep_prob[w];
+        if (kp >= 1.0f || rng.next_double() <= kp) kept.push_back(w);
+    }
+    const int64_t L = static_cast<int64_t>(kept.size());
+    for (int64_t i = 0; i < L; ++i) {
+        const int64_t b = (W > 0) ? rng.next_below(W) : 0;  // [0, W)
+        centers[row] = kept[static_cast<size_t>(i)];
+        int32_t* ctx = contexts + row * C;
+        float* m = mask + row * C;
+        std::memset(ctx, 0, sizeof(int32_t) * C);
+        std::memset(m, 0, sizeof(float) * C);
+        // context positions [max(0,i-b), min(i+b,L)) excluding i;
+        // lane layout matches corpus.batching.window_offsets:
+        // lanes [0, W-1) hold offsets -(W-1)..-1, lanes [W-1, C) hold
+        // offsets 1..W-2.
+        const int64_t lo = (i - b) > 0 ? (i - b) : 0;
+        const int64_t hi = (i + b) < L ? (i + b) : L;
+        for (int64_t j = lo; j < hi; ++j) {
+            if (j == i) continue;
+            const int64_t off = j - i;  // in [-(W-1), W-2], != 0
+            const int64_t lane = off < 0 ? off + (W - 1) : (W - 1) + off - 1;
+            ctx[lane] = kept[static_cast<size_t>(j)];
+            m[lane] = 1.0f;
+        }
+        ++row;
+    }
+    return L;
+}
+
+}  // namespace
+
+extern "C" {
+
 int64_t window_batch_epoch(
     const int32_t* ids, const int64_t* offsets, int64_t n_sentences,
     const float* keep_prob, int32_t window, uint64_t seed,
     int32_t* centers, int32_t* contexts, float* mask,
-    int64_t capacity, int64_t* words_done_out) {
+    int64_t capacity, int64_t* words_done_out, int32_t threads) {
     const int64_t W = window;
     const int64_t C = (2 * W - 3) > 1 ? (2 * W - 3) : 1;
-    Rng rng(seed);
-    int64_t row = 0;
-    int64_t words_done = 0;
-    std::vector<int32_t> kept;
-    for (int64_t s = 0; s < n_sentences; ++s) {
-        const int64_t beg = offsets[s], end = offsets[s + 1];
-        words_done += end - beg;
-        kept.clear();
-        for (int64_t i = beg; i < end; ++i) {
-            const int32_t w = ids[i];
-            const float kp = keep_prob[w];
-            if (kp >= 1.0f || rng.next_double() <= kp) kept.push_back(w);
-        }
-        const int64_t L = static_cast<int64_t>(kept.size());
-        if (row + L > capacity) return -1;
-        for (int64_t i = 0; i < L; ++i) {
-            const int64_t b = (W > 0) ? rng.next_below(W) : 0;  // [0, W)
-            centers[row] = kept[i];
-            int32_t* ctx = contexts + row * C;
-            float* m = mask + row * C;
-            std::memset(ctx, 0, sizeof(int32_t) * C);
-            std::memset(m, 0, sizeof(float) * C);
-            // context positions [max(0,i-b), min(i+b,L)) excluding i;
-            // lane layout matches corpus.batching.window_offsets:
-            // lanes [0, W-1) hold offsets -(W-1)..-1, lanes [W-1, C) hold
-            // offsets 1..W-2.
-            const int64_t lo = (i - b) > 0 ? (i - b) : 0;
-            const int64_t hi = (i + b) < L ? (i + b) : L;
-            for (int64_t j = lo; j < hi; ++j) {
-                if (j == i) continue;
-                const int64_t off = j - i;  // in [-(W-1), W-2], != 0
-                const int64_t lane = off < 0 ? off + (W - 1) : (W - 1) + off - 1;
-                ctx[lane] = kept[static_cast<size_t>(j)];
-                m[lane] = 1.0f;
-            }
-            ++row;
-        }
+    int64_t T = threads > 0
+                    ? threads
+                    : static_cast<int64_t>(std::thread::hardware_concurrency());
+    if (T < 1) T = 1;
+    if (T > n_sentences) T = n_sentences > 0 ? n_sentences : 1;
+
+    // Contiguous sentence chunks balanced by word count, not sentence
+    // count (sentence lengths vary).
+    const int64_t total_words = n_sentences > 0 ? offsets[n_sentences] : 0;
+    std::vector<int64_t> chunk_begin(T + 1, n_sentences);
+    chunk_begin[0] = 0;
+    for (int64_t t = 1; t < T; ++t) {
+        const int64_t target = total_words * t / T;
+        chunk_begin[t] = std::lower_bound(offsets, offsets + n_sentences + 1,
+                                          target) -
+                         offsets;
+        if (chunk_begin[t] > n_sentences) chunk_begin[t] = n_sentences;
+        if (chunk_begin[t] < chunk_begin[t - 1])
+            chunk_begin[t] = chunk_begin[t - 1];
     }
-    if (words_done_out) *words_done_out = words_done;
-    return row;
+    chunk_begin[T] = n_sentences;
+
+    // Runs fn(0..T-1): T-1 spawned workers, the last chunk on the caller
+    // thread. If pthread creation fails mid-loop (thread rlimit, EAGAIN),
+    // the unspawned chunks simply run inline — never std::terminate via
+    // a joinable-thread destructor.
+    auto run_parallel = [&](auto&& fn) {
+        std::vector<std::thread> pool;
+        pool.reserve(T > 0 ? T - 1 : 0);
+        int64_t spawned = 0;
+        try {
+            for (int64_t t = 0; t + 1 < T; ++t) {
+                pool.emplace_back(fn, t);
+                ++spawned;
+            }
+        } catch (...) {
+            // degrade below: chunks [spawned, T) run on this thread
+        }
+        for (int64_t t = spawned; t < T; ++t) fn(t);
+        for (auto& th : pool) th.join();
+    };
+
+    // Phase 1: kept-row count per chunk.
+    std::vector<int64_t> chunk_rows(T, 0);
+    auto count_chunk = [&](int64_t t) {
+        int64_t rows = 0;
+        for (int64_t s = chunk_begin[t]; s < chunk_begin[t + 1]; ++s)
+            rows += count_kept(ids, offsets[s], offsets[s + 1], keep_prob,
+                               sentence_seed(seed, s));
+        chunk_rows[t] = rows;
+    };
+    run_parallel(count_chunk);
+    std::vector<int64_t> chunk_start(T + 1, 0);
+    for (int64_t t = 0; t < T; ++t)
+        chunk_start[t + 1] = chunk_start[t] + chunk_rows[t];
+    if (chunk_start[T] > capacity) return -1;
+
+    // Phase 2: fill — each chunk writes its own disjoint row range.
+    auto fill_chunk = [&](int64_t t) {
+        std::vector<int32_t> kept;
+        int64_t row = chunk_start[t];
+        for (int64_t s = chunk_begin[t]; s < chunk_begin[t + 1]; ++s)
+            row += fill_sentence(ids, offsets[s], offsets[s + 1], keep_prob,
+                                 sentence_seed(seed, s), W, C, row, centers,
+                                 contexts, mask, kept);
+    };
+    run_parallel(fill_chunk);
+
+    if (words_done_out) *words_done_out = total_words;
+    return chunk_start[T];
 }
 
 }  // extern "C"

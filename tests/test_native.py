@@ -327,3 +327,24 @@ def test_corpus_scanner_matches_python_on_reference_corpus():
     # skill): vocab 3,609 at min_count=5, ~116.5k kept words.
     assert len(words) == 3609
     assert ids.size == 116561
+
+
+def test_native_epoch_thread_count_invariance():
+    """The parallel epoch pass must be byte-identical for every thread
+    count (deterministic per-sentence seeds + two-phase count/fill)."""
+    from glint_word2vec_tpu.native import window_batch_epoch_native
+
+    rng = np.random.default_rng(0)
+    sents = [rng.integers(0, 500, rng.integers(1, 40)).astype(np.int32)
+             for _ in range(500)]
+    ids = np.concatenate(sents)
+    lens = np.array([len(s) for s in sents], np.int64)
+    offs = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    kp = np.clip(rng.random(500).astype(np.float32) * 1.4, 0, 1)
+    ref = window_batch_epoch_native(ids, offs, kp, 4, 7, threads=1)
+    for t in (2, 3, 8):
+        out = window_batch_epoch_native(ids, offs, kp, 4, 7, threads=t)
+        for a, b in zip(ref[:3], out[:3]):
+            np.testing.assert_array_equal(a, b)
+        assert ref[3] == out[3]
