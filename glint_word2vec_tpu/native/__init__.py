@@ -98,7 +98,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
         ]
         lib.corpus_open.restype = ctypes.c_void_p
-        lib.corpus_open.argtypes = [ctypes.c_char_p]
+        lib.corpus_open.argtypes = [ctypes.c_char_p, ctypes.c_int32]
         lib.corpus_vocab_size.restype = ctypes.c_int64
         lib.corpus_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.corpus_vocab_chars.restype = ctypes.c_int64
@@ -126,6 +126,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _resolve_threads(threads: Optional[int]) -> int:
+    """Thread count for the native parallel passes: an explicit argument
+    wins; otherwise GLINT_NATIVE_THREADS (non-numeric/empty tolerated);
+    0 = one per hardware core (resolved in C++)."""
+    if threads is not None:
+        return int(threads)
+    try:
+        return int(os.environ.get("GLINT_NATIVE_THREADS", "0"))
+    except ValueError:
+        return 0
 
 
 def alias_build_native(weights: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -170,11 +182,7 @@ def window_batch_epoch_native(
     lib = get_lib()
     if lib is None:
         return None
-    if threads is None:
-        try:
-            threads = int(os.environ.get("GLINT_NATIVE_THREADS", "0"))
-        except ValueError:  # empty/non-numeric: hardware default
-            threads = 0
+    threads = _resolve_threads(threads)
     C = max(1, 2 * int(window) - 3)
     ids_c = np.ascontiguousarray(ids, dtype=np.int32)
     off_c = np.ascontiguousarray(offsets, dtype=np.int64)
@@ -203,9 +211,13 @@ def corpus_scan_native(
     min_count: int,
     max_sentence_length: int,
     lowercase: bool = False,
+    threads: Optional[int] = None,
 ) -> Optional[Tuple[list, np.ndarray, np.ndarray, np.ndarray]]:
     """Native fit_file ingestion: both corpus passes (vocab count + flat
-    encode) in C++, one file handle each.
+    encode) in C++, one file handle each. The counting pass runs
+    thread-parallel over mmap'd chunks for large files, with output
+    identical to the sequential pass for every thread count; ``threads``
+    None reads GLINT_NATIVE_THREADS (0 = one per hardware core).
 
     Returns ``(words, counts int64[n], ids int32[total], offsets
     int64[n_sentences+1])`` — the inputs ``Vocabulary`` + the flat corpus
@@ -228,7 +240,7 @@ def corpus_scan_native(
     lib = get_lib()
     if lib is None:
         return None
-    h = lib.corpus_open(os.fsencode(path))
+    h = lib.corpus_open(os.fsencode(path), _resolve_threads(threads))
     if not h:
         return None
     try:

@@ -24,12 +24,21 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <string_view>
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#if defined(__unix__) || defined(__APPLE__)
+#define GLINT_HAVE_MMAP 1
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#endif
 
 extern "C" {
 
@@ -313,6 +322,14 @@ int64_t window_batch_epoch(
 
 namespace {
 
+// Single-byte (ASCII) whitespace, the str.split() subset below 0x80.
+// Shared by sep_len AND the parallel chunk-boundary search: boundaries
+// may only land on bytes BOTH agree are separators, or a token could be
+// silently split across chunks.
+inline bool is_ascii_ws(unsigned char c) {
+    return c == ' ' || (c >= 0x09 && c <= 0x0d) || (c >= 0x1c && c <= 0x1f);
+}
+
 // Byte length of the whitespace separator starting at p (sequences are
 // block-complete by construction), or 0 if p starts a token byte.
 // *line_end_out: '\n' / '\r' — universal-newline sentence boundaries.
@@ -321,8 +338,7 @@ inline size_t sep_len(const unsigned char* p, size_t rem,
     const unsigned char c = p[0];
     *line_end_out = (c == '\n' || c == '\r');
     if (*line_end_out) return 1;
-    if (c == ' ' || (c >= 0x09 && c <= 0x0d) || (c >= 0x1c && c <= 0x1f))
-        return 1;
+    if (is_ascii_ws(c)) return 1;
     if (c < 0x80) return 0;
     if (c == 0xC2 && rem >= 2 && (p[1] == 0x85 || p[1] == 0xA0))
         return 2;  // U+0085 NEL, U+00A0 NBSP
@@ -385,23 +401,39 @@ size_t utf8_tail(const char* s, size_t n) {
 
 struct Ent {
     int64_t count;
-    int64_t first;  // insertion index: the count-desc sort tiebreak
+    int64_t first;  // first-occurrence order key: the count-desc tiebreak
 };
 
 struct Corpus {
     std::string path;
-    std::unordered_map<std::string, Ent> tab;
-    // Token stream as provisional (first-seen) ids + raw line lengths,
-    // recorded during the counting pass; freed by corpus_encode (one-shot).
+    // Unified post-count vocab store, indexed by gid (assigned in a
+    // deterministic first-occurrence order): words[gid] are string_views
+    // into `tab` keys (streaming path) or the mmap (parallel path) —
+    // both stable for the handle's lifetime.
+    std::vector<std::string_view> words;
+    std::vector<Ent> ents;
+    std::unordered_map<std::string, int64_t> tab;  // streaming byte owner
+    char* map_base = nullptr;  // parallel-path byte owner
+    size_t map_len = 0;
+    // Token stream as gids + raw line lengths, recorded during the
+    // counting pass; freed by corpus_encode (one-shot).
     std::vector<int32_t> prov;
     std::vector<int64_t> prov_lens;
     bool prov_consumed = false;
     // Sorted vocab cache for the min_count last queried.
     int64_t cached_min = -1;
-    std::vector<std::pair<const std::string*, const Ent*>> sorted;
+    std::vector<int64_t> sorted_gids;
     // Encode results.
     std::vector<int32_t> enc_ids;
     std::vector<int64_t> enc_lens;
+
+    // Every delete path (including the invalid-UTF-8 bail in corpus_open)
+    // must release the mapping, so it lives in the destructor.
+    ~Corpus() {
+#ifdef GLINT_HAVE_MMAP
+        if (map_base) munmap(map_base, map_len);
+#endif
+    }
 };
 
 // Streams `path` in ~1 MiB UTF-8-aligned blocks, calling token(ptr, len)
@@ -477,55 +509,57 @@ bool scan_file(const std::string& path, TokenFn&& token, LineFn&& line_end) {
 
 void ensure_sorted(Corpus* c, int64_t min_count) {
     if (c->cached_min == min_count) return;
-    c->sorted.clear();
-    c->sorted.reserve(c->tab.size());
-    for (const auto& kv : c->tab) {
-        if (kv.second.count >= min_count)
-            c->sorted.emplace_back(&kv.first, &kv.second);
+    c->sorted_gids.clear();
+    c->sorted_gids.reserve(c->ents.size());
+    for (int64_t g = 0; g < static_cast<int64_t>(c->ents.size()); ++g) {
+        if (c->ents[static_cast<size_t>(g)].count >= min_count)
+            c->sorted_gids.push_back(g);
     }
-    std::sort(c->sorted.begin(), c->sorted.end(),
-              [](const auto& a, const auto& b) {
-                  if (a.second->count != b.second->count)
-                      return a.second->count > b.second->count;
-                  return a.second->first < b.second->first;
+    std::sort(c->sorted_gids.begin(), c->sorted_gids.end(),
+              [c](int64_t a, int64_t b) {
+                  const Ent& ea = c->ents[static_cast<size_t>(a)];
+                  const Ent& eb = c->ents[static_cast<size_t>(b)];
+                  if (ea.count != eb.count) return ea.count > eb.count;
+                  return ea.first < eb.first;
               });
     c->cached_min = min_count;
 }
 
+inline bool token_utf8_ok(const char* p, size_t n) {
+    bool ascii = true;
+    for (size_t k = 0; k < n; ++k)
+        if (static_cast<unsigned char>(p[k]) >= 0x80) {
+            ascii = false;
+            break;
+        }
+    return ascii || valid_utf8(p, n);
+}
+
 }  // namespace
 
-extern "C" {
+namespace {
 
-// Opens `path` and runs the counting pass. Returns a handle (free with
-// corpus_free), or nullptr if the file can't be read OR contains invalid
-// UTF-8 (the caller then uses the Python path, whose errors='replace'
-// decode semantics a byte-level pass cannot reproduce).
-void* corpus_open(const char* path) {
-    auto* c = new Corpus;
-    c->path = path;
+// Streaming (fread-based) counting pass: fills the unified vocab store
+// sequentially. Used for small files, threads==1, or when mmap is
+// unavailable. Returns false on I/O error or invalid UTF-8.
+bool count_streaming(Corpus* c) {
     c->tab.reserve(1 << 20);
     int64_t line_start = 0;
-    bool ok = scan_file(
+    return scan_file(
         c->path,
         [&](const char* p, size_t n) -> bool {
-            bool ascii = true;
-            for (size_t k = 0; k < n; ++k)
-                if (static_cast<unsigned char>(p[k]) >= 0x80) {
-                    ascii = false;
-                    break;
-                }
-            if (!ascii && !valid_utf8(p, n)) return false;
-            std::string w(p, n);
-            auto it = c->tab.find(w);
-            int64_t id;
-            if (it == c->tab.end()) {
-                id = static_cast<int64_t>(c->tab.size());
-                c->tab.emplace(std::move(w), Ent{1, id});
+            if (!token_utf8_ok(p, n)) return false;
+            auto [it, inserted] = c->tab.try_emplace(
+                std::string(p, n),
+                static_cast<int64_t>(c->words.size()));
+            const int64_t gid = it->second;
+            if (inserted) {
+                c->words.emplace_back(it->first);
+                c->ents.push_back(Ent{1, gid});
             } else {
-                ++it->second.count;
-                id = it->second.first;
+                ++c->ents[static_cast<size_t>(gid)].count;
             }
-            c->prov.push_back(static_cast<int32_t>(id));
+            c->prov.push_back(static_cast<int32_t>(gid));
             return true;
         },
         [&] {
@@ -533,6 +567,241 @@ void* corpus_open(const char* path) {
                 static_cast<int64_t>(c->prov.size()) - line_start);
             line_start = static_cast<int64_t>(c->prov.size());
         });
+}
+
+#ifdef GLINT_HAVE_MMAP
+
+// Parallel counting pass over an mmap'd file: contiguous byte chunks
+// split at ASCII whitespace (so neither tokens nor multi-byte Unicode
+// separators straddle a boundary), each scanned into a chunk-local
+// vocab, then a deterministic sequential merge assigns gids in global
+// first-occurrence order — the output (words/ents/prov/prov_lens) is
+// byte-identical to the streaming pass for every thread count.
+struct ChunkScan {
+    std::unordered_map<std::string_view, int32_t> lmap;
+    std::vector<std::string_view> lwords;
+    std::vector<int64_t> lcounts;
+    std::vector<int64_t> lfirst;   // chunk-local token index of 1st occur.
+    std::vector<int32_t> lprov;    // local ids per token
+    std::vector<int64_t> lbreaks;  // local token count at each line end
+    bool bad = false;
+};
+
+void scan_chunk(const char* base, size_t beg, size_t end, ChunkScan* out) {
+    size_t i = beg;
+    while (i < end) {
+        bool is_line;
+        const size_t sl =
+            sep_len(reinterpret_cast<const unsigned char*>(base) + i,
+                    end - i, &is_line);
+        if (sl) {
+            if (is_line)
+                out->lbreaks.push_back(
+                    static_cast<int64_t>(out->lprov.size()));
+            i += sl;
+            continue;
+        }
+        size_t j = i;
+        bool dummy;
+        while (j < end &&
+               sep_len(reinterpret_cast<const unsigned char*>(base) + j,
+                       end - j, &dummy) == 0)
+            ++j;
+        if (!token_utf8_ok(base + i, j - i)) {
+            out->bad = true;
+            return;
+        }
+        std::string_view w(base + i, j - i);
+        auto [it, inserted] = out->lmap.try_emplace(
+            w, static_cast<int32_t>(out->lwords.size()));
+        const int32_t lid = it->second;
+        if (inserted) {
+            out->lwords.push_back(w);
+            out->lcounts.push_back(1);
+            out->lfirst.push_back(
+                static_cast<int64_t>(out->lprov.size()));
+        } else {
+            ++out->lcounts[static_cast<size_t>(lid)];
+        }
+        out->lprov.push_back(lid);
+        i = j;
+    }
+}
+
+// Returns true on success; false = caller should fall back to streaming
+// (mmap failure) — invalid UTF-8 instead reports *invalid=true.
+bool count_parallel(Corpus* c, int64_t threads, bool* invalid) {
+    int fd = ::open(c->path.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+        ::close(fd);
+        return false;
+    }
+    const size_t n = static_cast<size_t>(st.st_size);
+    void* m = mmap(nullptr, n, PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (m == MAP_FAILED) return false;
+    c->map_base = static_cast<char*>(m);
+    c->map_len = n;
+    const char* base = c->map_base;
+
+    // >=8 MiB per chunk: below that, thread + merge overhead dominates.
+    // GLINT_NATIVE_CHUNK_BYTES overrides (tests use a tiny floor so the
+    // multi-chunk merge is exercised on small fixtures).
+    size_t chunk_floor = 8u << 20;
+    if (const char* e = std::getenv("GLINT_NATIVE_CHUNK_BYTES")) {
+        char* endp = nullptr;
+        const unsigned long long v = std::strtoull(e, &endp, 10);
+        if (endp && *endp == '\0' && v > 0) chunk_floor = v;
+    }
+    int64_t T = threads;
+    const int64_t by_size = static_cast<int64_t>(n / chunk_floor) + 1;
+    if (T > by_size) T = by_size;
+    if (T < 1) T = 1;
+
+    std::vector<size_t> bound(T + 1, n);
+    bound[0] = 0;
+    for (int64_t t = 1; t < T; ++t) {
+        size_t p = n * static_cast<size_t>(t) / static_cast<size_t>(T);
+        if (p < bound[t - 1]) p = bound[t - 1];
+        while (p < n && !is_ascii_ws(static_cast<unsigned char>(base[p])))
+            ++p;
+        bound[t] = p;
+    }
+
+    std::vector<ChunkScan> chunks(static_cast<size_t>(T));
+    {
+        std::vector<std::thread> pool;
+        pool.reserve(static_cast<size_t>(T > 0 ? T - 1 : 0));
+        int64_t spawned = 0;
+        try {
+            for (int64_t t = 0; t + 1 < T; ++t) {
+                pool.emplace_back(scan_chunk, base, bound[t], bound[t + 1],
+                                  &chunks[static_cast<size_t>(t)]);
+                ++spawned;
+            }
+        } catch (...) {
+        }
+        for (int64_t t = spawned; t < T; ++t)
+            scan_chunk(base, bound[t], bound[t + 1],
+                       &chunks[static_cast<size_t>(t)]);
+        for (auto& th : pool) th.join();
+    }
+    for (const auto& ch : chunks)
+        if (ch.bad) {
+            *invalid = true;
+            return true;  // handled: caller reports invalid UTF-8
+        }
+
+    // Chunk token offsets.
+    std::vector<int64_t> tok_off(T + 1, 0);
+    for (int64_t t = 0; t < T; ++t)
+        tok_off[t + 1] =
+            tok_off[t] +
+            static_cast<int64_t>(chunks[static_cast<size_t>(t)].lprov.size());
+
+    // Deterministic merge: chunks in order, words within a chunk in
+    // first-occurrence order -> gids follow global first occurrence.
+    std::unordered_map<std::string_view, int64_t> gmap;
+    std::vector<std::vector<int32_t>> luts(static_cast<size_t>(T));
+    for (int64_t t = 0; t < T; ++t) {
+        auto& ch = chunks[static_cast<size_t>(t)];
+        auto& lut = luts[static_cast<size_t>(t)];
+        lut.resize(ch.lwords.size());
+        for (size_t l = 0; l < ch.lwords.size(); ++l) {
+            auto [it, inserted] = gmap.try_emplace(
+                ch.lwords[l], static_cast<int64_t>(c->words.size()));
+            const int64_t gid = it->second;
+            if (inserted) {
+                c->words.push_back(ch.lwords[l]);
+                c->ents.push_back(Ent{ch.lcounts[l],
+                                      tok_off[t] + ch.lfirst[l]});
+            } else {
+                c->ents[static_cast<size_t>(gid)].count += ch.lcounts[l];
+            }
+            lut[l] = static_cast<int32_t>(gid);
+        }
+        ch.lmap.clear();
+    }
+
+    // Global prov stream: parallel per-chunk remap into disjoint ranges.
+    // Each chunk releases its local stream + lut the moment it is
+    // remapped, so peak memory stays ~one token stream plus the largest
+    // in-flight chunk set, not 2x the corpus.
+    c->prov.resize(static_cast<size_t>(tok_off[T]));
+    auto remap_chunk = [&](int64_t t) {
+        auto& ch = chunks[static_cast<size_t>(t)];
+        auto& lut = luts[static_cast<size_t>(t)];
+        int32_t* out = c->prov.data() + tok_off[t];
+        for (size_t i = 0; i < ch.lprov.size(); ++i)
+            out[i] = lut[static_cast<size_t>(ch.lprov[i])];
+        std::vector<int32_t>().swap(ch.lprov);
+        std::vector<int32_t>().swap(lut);
+    };
+    {
+        std::vector<std::thread> pool;
+        pool.reserve(static_cast<size_t>(T > 0 ? T - 1 : 0));
+        int64_t spawned = 0;
+        try {
+            for (int64_t t = 0; t + 1 < T; ++t) {
+                pool.emplace_back(remap_chunk, t);
+                ++spawned;
+            }
+        } catch (...) {
+        }
+        for (int64_t t = spawned; t < T; ++t) remap_chunk(t);
+        for (auto& th : pool) th.join();
+    }
+
+    // Line lengths: merged break positions + the EOF line end.
+    int64_t prev = 0;
+    for (int64_t t = 0; t < T; ++t) {
+        for (int64_t lb : chunks[static_cast<size_t>(t)].lbreaks) {
+            c->prov_lens.push_back(tok_off[t] + lb - prev);
+            prev = tok_off[t] + lb;
+        }
+    }
+    c->prov_lens.push_back(tok_off[T] - prev);
+    return true;
+}
+
+#endif  // GLINT_HAVE_MMAP
+
+}  // namespace
+
+extern "C" {
+
+// Opens `path` and runs the counting pass — thread-parallel over mmap'd
+// byte chunks when `threads` allows (output identical to the sequential
+// pass for every thread count), streaming otherwise. Returns a handle
+// (free with corpus_free), or nullptr if the file can't be read OR
+// contains invalid UTF-8 (the caller then uses the Python path, whose
+// errors='replace' decode semantics a byte-level pass cannot reproduce).
+// threads: <=0 picks hardware_concurrency; 1 forces the streaming pass.
+void* corpus_open(const char* path, int32_t threads) {
+    auto* c = new Corpus;
+    c->path = path;
+    int64_t T = threads > 0
+                    ? threads
+                    : static_cast<int64_t>(
+                          std::thread::hardware_concurrency());
+    if (T < 1) T = 1;
+    bool ok = false;
+#ifdef GLINT_HAVE_MMAP
+    if (T > 1) {
+        bool invalid = false;
+        if (count_parallel(c, T, &invalid)) {
+            if (invalid) {
+                delete c;
+                return nullptr;
+            }
+            return c;
+        }
+        // mmap unavailable (pipe, empty file, ...): stream instead.
+    }
+#endif
+    ok = count_streaming(c);
     if (!ok) {
         delete c;
         return nullptr;
@@ -543,14 +812,15 @@ void* corpus_open(const char* path) {
 int64_t corpus_vocab_size(void* h, int64_t min_count) {
     auto* c = static_cast<Corpus*>(h);
     ensure_sorted(c, min_count);
-    return static_cast<int64_t>(c->sorted.size());
+    return static_cast<int64_t>(c->sorted_gids.size());
 }
 
 int64_t corpus_vocab_chars(void* h, int64_t min_count) {
     auto* c = static_cast<Corpus*>(h);
     ensure_sorted(c, min_count);
     int64_t total = 0;
-    for (const auto& e : c->sorted) total += e.first->size();
+    for (int64_t g : c->sorted_gids)
+        total += static_cast<int64_t>(c->words[static_cast<size_t>(g)].size());
     return total;
 }
 
@@ -563,10 +833,11 @@ int corpus_vocab_fill(void* h, int64_t min_count, char* chars, int64_t* offs,
     ensure_sorted(c, min_count);
     int64_t pos = 0, i = 0;
     offs[0] = 0;
-    for (const auto& e : c->sorted) {
-        std::memcpy(chars + pos, e.first->data(), e.first->size());
-        pos += static_cast<int64_t>(e.first->size());
-        counts[i] = e.second->count;
+    for (int64_t g : c->sorted_gids) {
+        const std::string_view w = c->words[static_cast<size_t>(g)];
+        std::memcpy(chars + pos, w.data(), w.size());
+        pos += static_cast<int64_t>(w.size());
+        counts[i] = c->ents[static_cast<size_t>(g)].count;
         offs[++i] = pos;
     }
     return 0;
@@ -589,10 +860,10 @@ int64_t corpus_encode(void* h, int64_t min_count, int64_t max_sentence_length,
     if (max_sentence_length <= 0) return -1;
     if (c->prov_consumed) return -1;
     ensure_sorted(c, min_count);
-    // remap[provisional first-seen id] -> frequency rank, or -1 (dropped).
-    std::vector<int32_t> remap(c->tab.size(), -1);
-    for (size_t i = 0; i < c->sorted.size(); ++i)
-        remap[static_cast<size_t>(c->sorted[i].second->first)] =
+    // remap[gid] -> frequency rank, or -1 (dropped by min_count).
+    std::vector<int32_t> remap(c->words.size(), -1);
+    for (size_t i = 0; i < c->sorted_gids.size(); ++i)
+        remap[static_cast<size_t>(c->sorted_gids[i])] =
             static_cast<int32_t>(i);
     c->enc_ids.clear();
     c->enc_lens.clear();

@@ -348,3 +348,62 @@ def test_native_epoch_thread_count_invariance():
         for a, b in zip(ref[:3], out[:3]):
             np.testing.assert_array_equal(a, b)
         assert ref[3] == out[3]
+
+
+class TestParallelScanner:
+    """The mmap-parallel counting pass must be byte-identical to the
+    streaming pass for every thread count and chunk size."""
+
+    def _mixed_corpus(self, tmp_path, lines=4000):
+        rng = np.random.default_rng(3)
+        p = tmp_path / "mixed.txt"
+        with open(p, "w", encoding="utf-8") as f:
+            for i in range(lines):
+                n = rng.integers(1, 25)
+                f.write(" ".join(f"w{x}" for x in rng.integers(0, 800, n)))
+                if i % 7 == 0:
+                    f.write(" extra　tok")  # unicode separators
+                f.write("\r\n" if i % 5 == 0 else "\n")
+            f.write("trailing no newline")
+        return str(p)
+
+    def test_parallel_identical_to_streaming(self, tmp_path, monkeypatch):
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        path = self._mixed_corpus(tmp_path)
+        # Tiny chunk floor so the file splits into many real chunks.
+        monkeypatch.setenv("GLINT_NATIVE_CHUNK_BYTES", "4096")
+        ref = corpus_scan_native(path, 2, 11, threads=1)
+        assert ref is not None
+        for t in (2, 3, 8):
+            out = corpus_scan_native(path, 2, 11, threads=t)
+            assert out is not None
+            assert out[0] == ref[0]
+            np.testing.assert_array_equal(out[1], ref[1])
+            np.testing.assert_array_equal(out[2], ref[2])
+            np.testing.assert_array_equal(out[3], ref[3])
+
+    def test_parallel_matches_python(self, tmp_path, monkeypatch):
+        from glint_word2vec_tpu.corpus.vocab import (
+            build_vocab, encode_file, iter_text_file,
+        )
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        path = self._mixed_corpus(tmp_path, lines=700)
+        monkeypatch.setenv("GLINT_NATIVE_CHUNK_BYTES", "2048")
+        out = corpus_scan_native(path, 1, 1000, threads=4)
+        assert out is not None
+        vocab = build_vocab(iter_text_file(path), min_count=1)
+        ids_py, offs_py = encode_file(path, vocab, max_sentence_length=1000)
+        assert out[0] == vocab.words
+        np.testing.assert_array_equal(out[1], vocab.counts)
+        np.testing.assert_array_equal(out[2], ids_py)
+        np.testing.assert_array_equal(out[3], offs_py)
+
+    def test_parallel_invalid_utf8_declines(self, tmp_path, monkeypatch):
+        from glint_word2vec_tpu.native import corpus_scan_native
+
+        p = tmp_path / "bad.txt"
+        p.write_bytes(b"ok tokens here\n" * 500 + b"bro\xffken\n")
+        monkeypatch.setenv("GLINT_NATIVE_CHUNK_BYTES", "1024")
+        assert corpus_scan_native(str(p), 1, 1000, threads=4) is None
