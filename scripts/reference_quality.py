@@ -80,7 +80,14 @@ def gates(model) -> dict:
     }
 
 
-def run(corpus: str, out_path: str) -> dict:
+def _mean_sd(xs):
+    n = len(xs)
+    mean = sum(xs) / n
+    sd = (sum((x - mean) ** 2 for x in xs) / max(n - 1, 1)) ** 0.5
+    return round(mean, 4), round(sd, 4)
+
+
+def run(corpus: str, out_path: str, n_seeds: int = 5) -> dict:
     from glint_word2vec_tpu.utils.platform import force_platform
 
     force_platform()
@@ -90,7 +97,7 @@ def run(corpus: str, out_path: str) -> dict:
     from glint_word2vec_tpu.parallel.mesh import make_mesh
 
     questions = analogy_questions()
-    results = {"corpus": corpus, "pairs": len(PAIRS)}
+    results = {"corpus": corpus, "pairs": len(PAIRS), "n_seeds": n_seeds}
 
     configs = {
         # The distributed estimator under test: TPU-shaped batch on the
@@ -120,74 +127,141 @@ def run(corpus: str, out_path: str) -> dict:
         ),
     }
 
+    # A single run of the 30-question suite has a binomial SE of ~0.09 ON
+    # TOP of training stochasticity — committed artifacts from single
+    # seeds swung 0.07<->0.27 across equally-valid PRNG streams. Every
+    # cell therefore trains n_seeds times (seed, seed+1, ...) and the
+    # artifact reports per-seed values plus mean +- sd; comparisons use
+    # means.
     for name, cfg in configs.items():
         cfg = dict(cfg)
         mesh_shape = cfg.pop("mesh")
-        t0 = time.time()
-        model = Word2Vec(mesh=make_mesh(*mesh_shape), **cfg).fit_file(
-            corpus, lowercase=True
-        )
+        base_seed = cfg.pop("seed")
+        per_seed = []
+        train_s = 0.0
+        for s in range(base_seed, base_seed + n_seeds):
+            t0 = time.time()
+            model = Word2Vec(
+                mesh=make_mesh(*mesh_shape), seed=s, **cfg
+            ).fit_file(corpus, lowercase=True)
+            train_s += time.time() - t0  # fit only; eval billed separately
+            per_seed.append({
+                "seed": s,
+                **gates(model),
+                "top1": evaluate_analogies(
+                    model, questions, top_k=1
+                ).to_dict()["accuracy"],
+                "top5": evaluate_analogies(
+                    model, questions, top_k=5
+                ).to_dict()["accuracy"],
+            })
+            vocab_size = model.vocab.size
+            model.stop()
+        t1_mean, t1_sd = _mean_sd([r["top1"] for r in per_seed])
+        t5_mean, t5_sd = _mean_sd([r["top5"] for r in per_seed])
         entry = {
-            "config": {**cfg, "mesh": list(mesh_shape)},
-            "train_seconds": round(time.time() - t0, 1),
-            "vocab_size": model.vocab.size,
-            **gates(model),
-            "analogy_top1": evaluate_analogies(model, questions, top_k=1).to_dict(),
-            "analogy_top5": evaluate_analogies(model, questions, top_k=5).to_dict(),
+            "config": {**cfg, "seed_base": base_seed, "mesh": list(mesh_shape)},
+            "train_seconds_total": round(train_s, 1),
+            "vocab_size": vocab_size,
+            "per_seed": per_seed,
+            "gate_synonym_pass_rate": round(
+                sum(r["gate_synonym"] for r in per_seed) / n_seeds, 2
+            ),
+            "gate_analogy_pass_rate": round(
+                sum(r["gate_analogy"] for r in per_seed) / n_seeds, 2
+            ),
+            "top1_mean": t1_mean, "top1_sd": t1_sd,
+            "top5_mean": t5_mean, "top5_sd": t5_sd,
         }
         results[name] = entry
-        model.stop()
         print(f"{name}: {json.dumps(entry)}", flush=True)
 
     # External control: a genuinely independent classic-SGNS implementation
     # (pure numpy, zero shared code — scripts/numpy_sgns_control.py), so the
     # quality table is not the framework grading itself (round-3 directive).
-    # This is the role gensim plays in the reference's ecosystem.
+    # This is the role gensim plays in the reference's ecosystem. Same
+    # multi-seed treatment.
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import numpy_sgns_control
 
-    ext = numpy_sgns_control.run(corpus)
+    ext_runs = [
+        numpy_sgns_control.run(corpus, seed=s) for s in range(1, 1 + n_seeds)
+    ]
+    e1_mean, e1_sd = _mean_sd(
+        [r["analogy_top1"]["accuracy"] for r in ext_runs]
+    )
+    e5_mean, e5_sd = _mean_sd(
+        [r["analogy_top5"]["accuracy"] for r in ext_runs]
+    )
+    ext = {
+        "implementation": ext_runs[0]["implementation"],
+        "config": ext_runs[0]["config"],
+        "vocab_size": ext_runs[0]["vocab_size"],
+        "per_seed": [
+            {"seed": r["config"]["seed"],
+             "top1": r["analogy_top1"]["accuracy"],
+             "top5": r["analogy_top5"]["accuracy"]}
+            for r in ext_runs
+        ],
+        "top1_mean": e1_mean, "top1_sd": e1_sd,
+        "top5_mean": e5_mean, "top5_sd": e5_sd,
+    }
     results["external_numpy_control"] = ext
     print(f"external_numpy_control: {json.dumps(ext)}", flush=True)
 
     d = results["distributed_2x2"]
     b = results["single_node_baseline"]
     m = results["distributed_2x2_matched"]
+    # Two-sample SEM on the mean gap; per-run sd floored at the binomial
+    # 0.09 so tiny samples can't fake certainty.
+    import math
+
+    def sem_gap(sd_a, sd_b):
+        fa, fb = max(sd_a, 0.09), max(sd_b, 0.09)
+        return math.sqrt((fa * fa + fb * fb) / n_seeds)
+
     results["summary"] = {
-        "reference_gates_pass": d["gate_synonym"] and d["gate_analogy"],
-        "distributed_top1": d["analogy_top1"]["accuracy"],
-        "baseline_top1": b["analogy_top1"]["accuracy"],
-        "matched_top1": m["analogy_top1"]["accuracy"],
-        "external_control_top1": ext["analogy_top1"]["accuracy"],
-        "distributed_top5": d["analogy_top5"]["accuracy"],
-        "baseline_top5": b["analogy_top5"]["accuracy"],
-        "matched_top5": m["analogy_top5"]["accuracy"],
-        "external_control_top5": ext["analogy_top5"]["accuracy"],
-        "distributed_vs_baseline": round(
-            d["analogy_top1"]["accuracy"] - b["analogy_top1"]["accuracy"], 4
+        "n_seeds": n_seeds,
+        # BOTH reference gates (Spec.scala:297-302 synonym AND :342-348
+        # analogy) — they diverge in some configs, so report each.
+        "gate_synonym_pass_rate": d["gate_synonym_pass_rate"],
+        "gate_analogy_pass_rate": d["gate_analogy_pass_rate"],
+        "reference_gates_pass_rate": round(
+            sum(
+                r["gate_synonym"] and r["gate_analogy"]
+                for r in d["per_seed"]
+            ) / n_seeds,
+            2,
         ),
-        "meets_baseline_target": (
-            d["analogy_top1"]["accuracy"] >= b["analogy_top1"]["accuracy"]
+        "distributed_top1": d["top1_mean"],
+        "baseline_top1": b["top1_mean"],
+        "matched_top1": m["top1_mean"],
+        "external_control_top1": ext["top1_mean"],
+        "distributed_top5": d["top5_mean"],
+        "baseline_top5": b["top5_mean"],
+        "matched_top5": m["top5_mean"],
+        "external_control_top5": ext["top5_mean"],
+        "distributed_vs_baseline": round(
+            d["top1_mean"] - b["top1_mean"], 4
+        ),
+        "meets_baseline_target": bool(
+            d["top1_mean"]
+            >= b["top1_mean"] - 2 * sem_gap(d["top1_sd"], b["top1_sd"])
         ),
         # The apples-to-apples external check: the framework estimator at
-        # an equal trained-pair budget vs the independent numpy control.
-        # With only 30 questions the accuracy has a binomial standard
-        # error of ~0.09, so the gate is "within 2 SE on top-1 AND not
-        # behind on top-5", with the raw gaps recorded alongside.
+        # an equal trained-pair budget vs the independent numpy control,
+        # compared on multi-seed means within 2 SEM.
         "external_control_gap_top1": round(
-            m["analogy_top1"]["accuracy"] - ext["analogy_top1"]["accuracy"],
-            4,
+            m["top1_mean"] - ext["top1_mean"], 4
         ),
         "external_control_gap_top5": round(
-            m["analogy_top5"]["accuracy"] - ext["analogy_top5"]["accuracy"],
-            4,
+            m["top5_mean"] - ext["top5_mean"], 4
         ),
         "meets_external_control": bool(
-            m["analogy_top1"]["accuracy"]
-            >= ext["analogy_top1"]["accuracy"]
-            - 2 * (0.25 / 30) ** 0.5  # 2 SE at p=0.5, n=30 (conservative)
-            and m["analogy_top5"]["accuracy"]
-            >= ext["analogy_top5"]["accuracy"]
+            m["top1_mean"]
+            >= ext["top1_mean"] - 2 * sem_gap(m["top1_sd"], ext["top1_sd"])
+            and m["top5_mean"]
+            >= ext["top5_mean"] - 2 * sem_gap(m["top5_sd"], ext["top5_sd"])
         ),
     }
     with open(out_path, "w") as f:
@@ -199,6 +273,7 @@ def run(corpus: str, out_path: str) -> dict:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--corpus", default=DEFAULT_CORPUS)
+    ap.add_argument("--seeds", type=int, default=5)
     ap.add_argument(
         "--out",
         default=os.path.join(
@@ -207,4 +282,6 @@ if __name__ == "__main__":
         ),
     )
     a = ap.parse_args()
-    run(a.corpus, a.out)
+    if a.seeds < 1:
+        ap.error("--seeds must be >= 1")
+    run(a.corpus, a.out, n_seeds=a.seeds)
