@@ -125,6 +125,15 @@ def run(corpus: str, out_path: str, n_seeds: int = 5) -> dict:
             min_count=5, num_iterations=9, seed=1, steps_per_call=16,
             subsample_ratio=1e-3,
         ),
+        # The shared-negative-pool estimator (one pool of S draws per
+        # step, m_i*n/S weighting — the TPU-shaped dense-MXU variant):
+        # same config as distributed_2x2, so the artifact shows whether
+        # the estimator change costs quality.
+        "distributed_2x2_sharedneg": dict(
+            mesh=(2, 2), vector_size=100, step_size=0.025, batch_size=256,
+            min_count=5, num_iterations=2, seed=1, steps_per_call=16,
+            shared_negatives=4096,
+        ),
     }
 
     # A single run of the 30-question suite has a binomial SE of ~0.09 ON
@@ -212,6 +221,7 @@ def run(corpus: str, out_path: str, n_seeds: int = 5) -> dict:
     d = results["distributed_2x2"]
     b = results["single_node_baseline"]
     m = results["distributed_2x2_matched"]
+    sh = results["distributed_2x2_sharedneg"]
     # Two-sample SEM on the mean gap; per-run sd floored at the binomial
     # 0.09 so tiny samples can't fake certainty.
     import math
@@ -241,6 +251,15 @@ def run(corpus: str, out_path: str, n_seeds: int = 5) -> dict:
         "baseline_top5": b["top5_mean"],
         "matched_top5": m["top5_mean"],
         "external_control_top5": ext["top5_mean"],
+        "sharedneg_top1": sh["top1_mean"],
+        "sharedneg_top5": sh["top5_mean"],
+        "sharedneg_gates_pass_rate": round(
+            sum(
+                r["gate_synonym"] and r["gate_analogy"]
+                for r in sh["per_seed"]
+            ) / n_seeds,
+            2,
+        ),
         "distributed_vs_baseline": round(
             d["top1_mean"] - b["top1_mean"], 4
         ),
