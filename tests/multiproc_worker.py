@@ -10,6 +10,8 @@ owns 2 virtual CPU devices; together they form the 4-device ("data", "model")
 Asserts, inside the multi-host run itself:
   * fit() trains in lockstep across processes (steps > 0, finite loss);
   * sharded save/load round-trips (process-0 shard writes + manifest);
+  * fit_file() — the native-scanner ingestion + flat-corpus process
+    sharding path — reproduces fit(sentences) exactly;
   * checkpoint/resume across processes reproduces the uninterrupted fit
     exactly (same schedule, same keys);
   * query surface works identically on every process.
@@ -118,6 +120,24 @@ def main() -> int:
     syn_d = model_dims.find_synonyms("w0", 5)
     assert len(syn_d) == 5 and all(np.isfinite(s) for _, s in syn_d)
     multihost_utils.sync_global_devices("dims_done")
+
+    # --- fit_file under multi-host: the native scanner + flat-corpus
+    # process sharding path. Process 0 writes the corpus; both read it
+    # (the shared-filesystem contract). Must reproduce fit(sentences)
+    # exactly: same vocab, same schedule, same draws.
+    corpus_path = os.path.join(workdir, "corpus.txt")
+    if pid == 0:
+        with open(corpus_path, "w", encoding="utf-8") as f:
+            for s in sentences:
+                f.write(" ".join(s))
+                f.write("\n")
+    multihost_utils.sync_global_devices("corpus_written")
+    model_ff = Word2Vec(**common).fit_file(corpus_path)
+    assert model_ff.vocab.words == model.vocab.words
+    np.testing.assert_allclose(
+        model_ff.transform("w0"), ref_vec, rtol=1e-5, atol=1e-6
+    )
+    multihost_utils.sync_global_devices("fit_file_done")
 
     # --- checkpoint/resume across processes ---------------------------
     ck = os.path.join(workdir, "ck")
