@@ -22,7 +22,7 @@ EOF
 wait_alive() {
   until probe_alive; do
     echo "chip unreachable $(date)" >> "$L"
-    sleep 120
+    sleep 30
   done
   echo "chip ALIVE $(date)" >> "$L"
 }
