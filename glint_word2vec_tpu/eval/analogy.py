@@ -16,7 +16,7 @@ engine's distributed top-k, never a host-side O(vocab) scan
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -32,7 +32,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
 logger = logging.getLogger(__name__)
 
