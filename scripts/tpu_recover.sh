@@ -36,21 +36,21 @@ stage() {  # stage NAME TIMEOUT CMD...
 }
 
 stage dtype_scan_probe 1200 \
-  python scripts/dtype_scan_probe.py --out /tmp/dtype_scan_probe.json
+  python scripts/dtype_scan_probe.py --out PROBE_r04_dtype_scan.json
 
 stage bench 900 \
-  bash -c 'python bench.py > /tmp/bench_tpu2.json'
+  bash -c 'python bench.py > BENCH_r04_prelim.json'
 
 stage scale_test 1800 \
   bash -c 'python scripts/scale_test.py > /tmp/scale_tpu2.json'
 
 stage fit_file_bench 1500 \
   env FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
-  bash -c 'python scripts/fit_file_bench.py > /tmp/fitfile_tpu.json'
+  bash -c 'python scripts/fit_file_bench.py > FITFILE_r04.json'
 
 stage bench_sweep 2400 python scripts/bench_sweep.py
 
 stage pallas_retry 600 \
-  bash -c 'python scripts/pallas_bench.py > /tmp/pallas_tpu.json'
+  bash -c 'python scripts/pallas_bench.py > PALLAS_r04.json'
 
 echo "=== tpu_recover done $(date) ===" >> "$L"
