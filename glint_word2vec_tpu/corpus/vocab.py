@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import collections
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Iterator, List, Sequence, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -44,7 +44,8 @@ class Vocabulary:
 
     @classmethod
     def from_sorted(
-        cls, words: List[str], counts: np.ndarray, min_count: int = None
+        cls, words: List[str], counts: np.ndarray,
+        min_count: Optional[int] = None,
     ) -> "Vocabulary":
         """Assemble a Vocabulary from an already-sorted (count desc,
         first-seen ties) word/count listing — the single construction

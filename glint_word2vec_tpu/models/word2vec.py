@@ -289,12 +289,21 @@ class Word2Vec:
         rest — GLINT_DEVICE_CORPUS_MAX_BYTES overrides the 2 GiB
         default), and no env escape hatch. Single-process only — the
         caller checks process count."""
-        budget = int(
-            os.environ.get("GLINT_DEVICE_CORPUS_MAX_BYTES", 2 << 30)
-        )
+        raw_budget = os.environ.get("GLINT_DEVICE_CORPUS_MAX_BYTES")
+        try:
+            budget = int(raw_budget) if raw_budget is not None else 2 << 30
+        except ValueError:
+            logger.warning(
+                "GLINT_DEVICE_CORPUS_MAX_BYTES=%r is not an integer; "
+                "using the 2 GiB default", raw_budget,
+            )
+            budget = 2 << 30
         return (
             self.params.subsample_ratio == 0.0
             and 4 * corpus_words <= budget
+            # upload_corpus indexes the flat corpus with int32; an
+            # oversized corpus routes to the host batcher, not an error.
+            and corpus_words < 2**31
             and os.environ.get("GLINT_HOST_BATCHER", "0") != "1"
         )
 

@@ -925,10 +925,9 @@ class EmbeddingEngine:
         if jax.process_count() > 1:
             gm = np.ones((B, 1), dtype=np.float32)
         else:
-            if self._ones_mask_cache.get("key1") != B:
-                self._ones_mask_cache["key1"] = B
-                self._ones_mask_cache["val1"] = jnp.ones((B, 1), jnp.float32)
-            gm = self._ones_mask_cache["val1"]
+            if (B,) not in self._ones_mask_cache:
+                self._ones_mask_cache[(B,)] = jnp.ones((B, 1), jnp.float32)
+            gm = self._ones_mask_cache[(B,)]
         return self.train_step_grouped(
             centers[:, None], gm, contexts, mask, key, alpha,
         )
@@ -1006,12 +1005,13 @@ class EmbeddingEngine:
             # device->host per call there.
             gm = np.ones((K, B, 1), dtype=np.float32)
         else:
-            if self._ones_mask_cache.get("keyK") != (K, B):
-                self._ones_mask_cache["keyK"] = (K, B)
-                self._ones_mask_cache["valK"] = jnp.ones(
+            # Keyed by shape so callers alternating between batch shapes
+            # don't rebuild and re-upload the constant mask every call.
+            if (K, B) not in self._ones_mask_cache:
+                self._ones_mask_cache[(K, B)] = jnp.ones(
                     (K, B, 1), jnp.float32
                 )
-            gm = self._ones_mask_cache["valK"]
+            gm = self._ones_mask_cache[(K, B)]
         return self.train_steps_grouped(
             centers_k[:, :, None], gm,
             contexts_k, mask_k, base_key, alphas, step0,

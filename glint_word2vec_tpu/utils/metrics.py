@@ -91,8 +91,15 @@ class TrainingMetrics:
         wall = max(time.time() - self._t_start, 1e-9)
         if self._last_loss_lazy is not None:
             # One sync at summary time so short runs (fewer than log_every
-            # steps) still report a final loss.
-            self.last_loss = float(self._last_loss_lazy)
+            # steps) still report a final loss. An async dispatch failure
+            # surfaces here, not at the step — keep the last synced loss
+            # rather than crashing the summary; either way drop the device
+            # buffer so it is not pinned for the run's lifetime.
+            try:
+                self.last_loss = float(self._last_loss_lazy)
+            except Exception:
+                pass
+            self._last_loss_lazy = None
         return {
             "steps": self.steps,
             "words_done": self.words_done,

@@ -1,0 +1,2 @@
+# TIMEOUT=1500
+python scripts/dtype_scan_probe.py --out PROBE_r05_dtype_scan.json

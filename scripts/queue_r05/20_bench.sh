@@ -1,0 +1,2 @@
+# TIMEOUT=1800
+BENCH_PARTIAL=/tmp/bench_r05_partial.json python bench.py > BENCH_r05_prelim.json

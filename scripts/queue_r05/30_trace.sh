@@ -1,0 +1,2 @@
+# TIMEOUT=900
+python scripts/trace_step.py --out /tmp/glint_trace_r05 > TRACE_r05.json

@@ -1,0 +1,2 @@
+# TIMEOUT=1800
+python scripts/scale_test.py > /tmp/scale_r05_stdout.json

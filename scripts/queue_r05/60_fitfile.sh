@@ -1,0 +1,3 @@
+# TIMEOUT=1800
+FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
+  python scripts/fit_file_bench.py > FITFILE_r05.json

@@ -1,0 +1,2 @@
+# TIMEOUT=2400
+python scripts/bench_sweep.py

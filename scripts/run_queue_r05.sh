@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-5 measurement-queue runner.
+#
+# Design (round-4 lesson: a dead tunnel burned every stage's timeout and
+# round 4 shipped zero on-chip numbers):
+#   - stages live as files in scripts/queue_r05/NN_name.sh, run in sorted
+#     order; a stage is skipped once NN_name.done exists, so the runner can
+#     be restarted safely and new stages can be APPENDED while it runs;
+#   - before every stage the chip is liveness-probed with a tiny matmul in
+#     a subprocess; measurement budget is only spent on a live link;
+#   - after draining the queue the runner rescans every 60s for new stage
+#     files until scripts/queue_r05/STOP exists.
+#
+# Log: /tmp/queue_r05.log  Per-stage logs: scripts/queue_r05/NN_name.log
+set -u
+cd "$(dirname "$0")/.." || exit 1
+Q=scripts/queue_r05
+L="${1:-/tmp/queue_r05.log}"
+echo "=== queue_r05 runner start $(date -u +%FT%TZ) pid=$$ ===" >> "$L"
+
+probe_alive() {
+  # First device init over the tunnel can exceed 120s; a short timeout
+  # would kill every probe mid-init and spin forever.
+  timeout 240 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform == "tpu", d
+x = jnp.ones((256, 256))
+assert float((x @ x).sum()) > 0
+EOF
+}
+
+wait_alive() {
+  until probe_alive; do
+    echo "chip unreachable $(date -u +%FT%TZ)" >> "$L"
+    sleep 45
+    [ -e "$Q/STOP" ] && return 1
+  done
+  echo "chip ALIVE $(date -u +%FT%TZ)" >> "$L"
+  return 0
+}
+
+run_stage() {
+  local f="$1" base to
+  base="${f%.sh}"
+  # Per-stage timeout: a "# TIMEOUT=N" line in the stage file, default 1200.
+  to=$(sed -n 's/^# TIMEOUT=\([0-9]*\).*/\1/p' "$f" | head -1)
+  to="${to:-1200}"
+  wait_alive || return
+  echo "--- stage $f (timeout ${to}s) $(date -u +%FT%TZ)" >> "$L"
+  timeout "$to" bash "$f" > "$base.log" 2>&1
+  local rc=$?
+  echo "rc=$rc $(date -u +%FT%TZ)" > "$base.done"
+  echo "stage $f rc=$rc $(date -u +%FT%TZ)" >> "$L"
+}
+
+while true; do
+  did_any=0
+  for f in "$Q"/[0-9]*.sh; do
+    [ -e "$f" ] || continue
+    [ -e "${f%.sh}.done" ] && continue
+    [ -e "$Q/STOP" ] && break
+    run_stage "$f"
+    did_any=1
+  done
+  if [ -e "$Q/STOP" ]; then
+    pending=$(ls "$Q"/[0-9]*.sh 2>/dev/null | while read -r f; do
+      [ -e "${f%.sh}.done" ] || echo "$f"; done | wc -l)
+    echo "STOP seen, $pending pending $(date -u +%FT%TZ)" >> "$L"
+    break
+  fi
+  [ "$did_any" = 0 ] && sleep 60
+done
+echo "=== queue_r05 runner exit $(date -u +%FT%TZ) ===" >> "$L"
