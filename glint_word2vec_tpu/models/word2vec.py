@@ -198,7 +198,21 @@ class Word2Vec:
         unaffected because it depends only on global progress counters).
         """
         p = self.params
-        sentences = list(sentences) if not isinstance(sentences, list) else sentences
+        if not isinstance(sentences, list):
+            # Non-rewindable input: single-pass streaming scan+encode
+            # into the flat representation (~4 bytes/kept word) instead
+            # of materializing a Python sentence list (~15x the RAM).
+            # Produces the same vocab/encoding as the list path below.
+            from glint_word2vec_tpu.corpus.vocab import scan_and_encode_stream
+
+            vocab, ids, offsets = scan_and_encode_stream(
+                sentences, min_count=p.min_count,
+                max_sentence_length=p.max_sentence_length,
+            )
+            return self._fit_flat(
+                vocab, ids, offsets, checkpoint_dir,
+                checkpoint_every_epochs, stop_after_epochs,
+            )
         vocab = build_vocab(sentences, min_count=p.min_count)
         encoded = chunk_sentences(
             encode_sentences(sentences, vocab), p.max_sentence_length
@@ -257,6 +271,25 @@ class Word2Vec:
             path, min_count=p.min_count,
             max_sentence_length=p.max_sentence_length, lowercase=lowercase,
         )
+        return self._fit_flat(
+            vocab, ids, offsets, checkpoint_dir, checkpoint_every_epochs,
+            stop_after_epochs,
+        )
+
+    def _fit_flat(
+        self,
+        vocab: Vocabulary,
+        ids: np.ndarray,
+        offsets: np.ndarray,
+        checkpoint_dir: Optional[str],
+        checkpoint_every_epochs: int,
+        stop_after_epochs: Optional[int],
+    ) -> "Word2VecModel":
+        """Train from the flat encoded corpus ``(ids, offsets)`` — the
+        common tail of ``fit_file`` and streaming-``fit``: route to the
+        device-resident scan when eligible, else shard across processes
+        and run the host batcher pipeline."""
+        p = self.params
         pc, local_batch, steps_per_epoch = self._multihost_plan(np.diff(offsets))
         if pc == 1 and self._device_corpus_eligible(int(ids.size)):
             return self._fit_corpus_resident(

@@ -1,2 +1,3 @@
 # TIMEOUT=900
-python scripts/trace_step.py --out /tmp/glint_trace_r05 > TRACE_r05.json
+python scripts/trace_step.py --out /tmp/glint_trace_r05 --steps 8 --spc 4 > TRACE_r05.json \
+  && python scripts/trace_summarize.py --trace /tmp/glint_trace_r05 --steps 32 --out TRACE_r05_summary.json

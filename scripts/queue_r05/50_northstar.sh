@@ -1,0 +1,2 @@
+# TIMEOUT=3600
+python scripts/scale_northstar.py > /tmp/northstar_stdout.json

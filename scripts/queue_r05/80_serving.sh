@@ -1,0 +1,2 @@
+# TIMEOUT=900
+GLINT_SERVE_SECONDS=4 python scripts/serving_bench.py > /tmp/serving_stdout.json

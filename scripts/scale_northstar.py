@@ -84,7 +84,12 @@ def _timed(fn, min_seconds=0.5, warm=True):
     return (time.time() - t0) / calls, calls
 
 
-def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags):
+def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags,
+               res, flush):
+    """Phases write into ``res`` and call ``flush()`` as each completes,
+    so a tunnel death mid-layout preserves every finished phase; the
+    engine is destroyed on ANY exit so a failed phase can't leave 12 GB
+    of tables pinned in HBM for the next layout's init to trip over."""
     from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
     from glint_word2vec_tpu.parallel.mesh import make_mesh
 
@@ -94,13 +99,24 @@ def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags):
         mesh, V, d, counts, num_negatives=5, seed=0,
         dtype="bfloat16", compute_dtype="bfloat16", layout=layout,
     )
+    try:
+        _run_layout_phases(
+            dev, eng, layout, V, d, B, W, spc, min_seconds, p, flags,
+            res, flush, mesh, t0,
+        )
+    finally:
+        eng.destroy()
+
+
+def _run_layout_phases(dev, eng, layout, V, d, B, W, spc, min_seconds, p,
+                       flags, res, flush, mesh, t0):
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+
     jax.block_until_ready(eng.syn0)
-    init_s = time.time() - t0
-    res = {
-        "layout": layout,
-        "init_seconds": round(init_s, 1),
-        "memory_after_init": _mem(dev),
-    }
+    res["layout"] = layout
+    res["init_seconds"] = round(time.time() - t0, 1)
+    res["memory_after_init"] = _mem(dev)
+    flush()
 
     # --- Training at the north-star geometry: the production
     # device-resident corpus scan (fit/fit_file single-process path).
@@ -140,6 +156,7 @@ def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags):
         "window": W,
     }
     res["memory_after_train"] = _mem(dev)
+    flush()
 
     # --- Full query surface at 10M rows.
     q_idx = rng.integers(0, V, size=4096).astype(np.int32)
@@ -154,6 +171,7 @@ def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags):
     s, c = _timed(lambda: eng.norms(), min_seconds)
     res["norms_ms"] = round(s * 1e3, 2)
     res["memory_after_queries"] = _mem(dev)
+    flush()
 
     # --- Persistence at size (once; both layouts write the same bytes).
     if flags.get("save_load"):
@@ -168,24 +186,25 @@ def run_layout(dev, layout, V, d, B, W, spc, min_seconds, counts, p, flags):
             for r, _, fs in os.walk(ckpt) for f in fs
         )
         # Free the live tables BEFORE loading: two engines at this
-        # geometry (2 x 12 GB) exceed one chip's HBM.
+        # geometry (2 x 12 GB) exceed one chip's HBM. (The caller's
+        # finally-destroy is idempotent.)
         eng.destroy()
         t0 = time.time()
         eng2 = EmbeddingEngine.load(ckpt, mesh)
-        jax.block_until_ready(eng2.syn0)
-        load_s = time.time() - t0
-        probe2 = np.asarray(eng2.pull(q_idx[:8]), dtype=np.float32)
-        res["save_load"] = {
-            "save_seconds": round(save_s, 1),
-            "load_seconds": round(load_s, 1),
-            "checkpoint_bytes": ckpt_bytes,
-            "roundtrip_exact": bool(np.array_equal(probe, probe2)),
-        }
-        eng2.destroy()
-        shutil.rmtree(ckpt, ignore_errors=True)
-    else:
-        eng.destroy()
-    return res
+        try:
+            jax.block_until_ready(eng2.syn0)
+            load_s = time.time() - t0
+            probe2 = np.asarray(eng2.pull(q_idx[:8]), dtype=np.float32)
+            res["save_load"] = {
+                "save_seconds": round(save_s, 1),
+                "load_seconds": round(load_s, 1),
+                "checkpoint_bytes": ckpt_bytes,
+                "roundtrip_exact": bool(np.array_equal(probe, probe2)),
+            }
+        finally:
+            eng2.destroy()
+            shutil.rmtree(ckpt, ignore_errors=True)
+        flush()
 
 
 def main():
@@ -216,15 +235,19 @@ def main():
     counts = np.maximum(1e9 / ranks, 1.0).astype(np.int64)
     p = (counts / counts.sum()).astype(np.float64)
 
-    for i, layout in enumerate(("dims", "rows")):
+    layouts = ("dims", "rows")
+    for i, layout in enumerate(layouts):
+        res = {}
+        fl.doc["layouts"][layout] = res
         try:
-            res = run_layout(
+            run_layout(
                 dev, layout, V, d, B, W, spc, min_seconds, counts, p,
-                {"save_load": i == len(("dims", "rows")) - 1},
+                {"save_load": i == len(layouts) - 1}, res, fl.flush,
             )
         except Exception as e:
-            res = {"layout": layout, "error": f"{type(e).__name__}: {e}"}
-        fl.doc["layouts"][layout] = res
+            # Finished phases are already in res/flushed; record what
+            # broke alongside them.
+            res["error"] = f"{type(e).__name__}: {e}"
         fl.flush()
     print(json.dumps(fl.doc))
 

@@ -1,0 +1,183 @@
+"""Measure the serving path: QPS + latency of ModelServer endpoints.
+
+The reference's separate-cluster topology serves queries from a live PS
+cluster (README.md:52-57, glint.Main); this repo restates that as
+serving.py's HTTP server over one loaded model (PARITY.md records the
+dissolution rationale). Round-4 verdict: nothing measured it. This
+script times the two production endpoints — /synonyms (device top-k
+under the single request lock) and /transform (device mean-vector) —
+under 1/4/16 concurrent closed-loop clients, reporting per-endpoint QPS
+and p50/p95 latency.
+
+Writes SERVING_r05.json (repo root) with the usual non-TPU fallback
+marker. Env: GLINT_SERVE_PLATFORM, GLINT_SERVE_SECONDS (per cell,
+default 4), GLINT_SERVE_MODEL (saved model dir; default trains a small
+model on the reference fixture corpus).
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform(os.environ.get("GLINT_SERVE_PLATFORM"))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "SERVING_r05.json",
+)
+
+
+def _build_model():
+    model_dir = os.environ.get("GLINT_SERVE_MODEL")
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1, 1, devices=[jax.devices()[0]])
+    if model_dir:
+        from glint_word2vec_tpu import load_model
+
+        return load_model(model_dir, mesh=mesh)
+    from glint_word2vec_tpu import Word2Vec
+
+    return Word2Vec(
+        mesh=mesh, vector_size=100, batch_size=256, min_count=5,
+        num_iterations=1, seed=1, steps_per_call=16,
+    ).fit_file(CORPUS, lowercase=True)
+
+
+def _client_loop(host, port, path, payloads, stop, lats, errors):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    i = 0
+    try:
+        while not stop.is_set():
+            body = payloads[i % len(payloads)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    errors.append(resp.status)
+                    continue
+            except Exception:
+                errors.append("conn")
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                continue
+            lats.append(time.perf_counter() - t0)
+    finally:
+        conn.close()
+
+
+def bench_endpoint(server, path, payloads, concurrency, seconds):
+    stop = threading.Event()
+    lats, errors = [], []
+    threads = [
+        threading.Thread(
+            target=_client_loop,
+            args=(server.host, server.port, path, payloads, stop, lats,
+                  errors),
+            daemon=True,
+        )
+        for _ in range(concurrency)
+    ]
+    # Warm (compile the jitted query fns) before the timed window.
+    warm_stop = threading.Event()
+    wl, we = [], []
+    _client_loop_once = threading.Thread(
+        target=_client_loop,
+        args=(server.host, server.port, path, payloads[:1], warm_stop, wl,
+              we),
+        daemon=True,
+    )
+    _client_loop_once.start()
+    t0 = time.time()
+    while not wl and not we and time.time() - t0 < 120:
+        time.sleep(0.05)
+    warm_stop.set()
+    _client_loop_once.join(timeout=30)
+
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    if not lats:
+        return {"error": f"no successful requests ({len(errors)} errors)"}
+    xs = np.asarray(sorted(lats))
+    return {
+        "concurrency": concurrency,
+        "requests": len(lats),
+        "errors": len(errors),
+        "qps": round(len(lats) / seconds, 1),
+        "p50_ms": round(float(np.quantile(xs, 0.50)) * 1e3, 2),
+        "p95_ms": round(float(np.quantile(xs, 0.95)) * 1e3, 2),
+    }
+
+
+def main():
+    from glint_word2vec_tpu.serving import ModelServer
+
+    dev = jax.devices()[0]
+    seconds = float(os.environ.get("GLINT_SERVE_SECONDS", 4.0))
+    model = _build_model()
+    server = ModelServer(model, port=0)  # ephemeral port
+    server.start_background()
+
+    rng = np.random.default_rng(0)
+    hot = min(200, model.vocab.size)  # query the frequent rows
+    words = [model.vocab.words[i] for i in rng.integers(0, hot, 64)]
+    syn_payloads = [
+        json.dumps({"word": w, "num": 10}).encode() for w in words
+    ]
+    sentences = [
+        [model.vocab.words[j] for j in rng.integers(0, hot, 10)]
+        for _ in range(16)
+    ]
+    tr_payloads = [
+        json.dumps({"sentences": [s]}).encode() for s in sentences
+    ]
+
+    out = {
+        "metric": "serving_qps",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "vocab_size": model.vocab.size,
+        "dim": model.vector_size,
+        "seconds_per_cell": seconds,
+        "endpoints": {},
+    }
+    if dev.platform != "tpu":
+        out["fallback"] = dev.platform
+    for path, payloads in (
+        ("/synonyms", syn_payloads), ("/transform", tr_payloads)
+    ):
+        cells = [
+            bench_endpoint(server, path, payloads, c, seconds)
+            for c in (1, 4, 16)
+        ]
+        out["endpoints"][path] = cells
+    server.stop()
+    model.stop()
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Summarize a jax.profiler xplane trace into a µs-by-op-class table.
+
+Round-4 verdict #4: the MFU story must become a measured breakdown —
+name the top time sinks (gather / scatter / dense / collective /
+sampling) in the hot step from an actual device trace, not arithmetic.
+The TensorBoard profile plugin's converter is ABI-broken against this
+container's TF (pywrap xspace_to_tools_data missing), so this parses
+the xplane protobuf directly (tensorflow.tsl.profiler.protobuf) and
+aggregates device-plane event durations by HLO class.
+
+Usage: python scripts/trace_summarize.py --trace DIR [--out FILE]
+Writes one JSON doc: per-device-plane total busy time and the per-class
+µs + share table, classified from the op/fusion names XLA emits.
+"""
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+# Order matters: first match wins. Patterns target XLA HLO op names and
+# the fusion names Mosaic/XLA emit on TPU (e.g. "fusion.3",
+# "all-reduce.1", "dynamic-update-slice.7", "rng-bit-generator").
+_CLASSES = [
+    ("collective", r"all-reduce|all-gather|reduce-scatter|all-to-all|"
+                   r"collective|psum|ppermute"),
+    ("scatter", r"scatter|dynamic-update-slice"),
+    ("gather", r"\bgather|dynamic-slice|take"),
+    ("dense_mxu", r"\bdot\b|dot_general|convolution|matmul|\bmul.*dot"),
+    ("rng_sampling", r"rng|threefry|random|iota"),
+    ("data_movement", r"copy|transpose|reshape|bitcast|broadcast|"
+                      r"concatenate|slice|pad\b"),
+    ("host_transfer", r"infeed|outfeed|transfer|send|recv"),
+]
+
+
+def classify(name: str) -> str:
+    low = name.lower()
+    for cls, pat in _CLASSES:
+        if re.search(pat, low):
+            return cls
+    if low.startswith("fusion") or ".fusion" in low:
+        # Unnamed fusions: elementwise chains fused around the matmuls.
+        return "fusion_other"
+    return "other"
+
+
+def summarize(trace_dir: str) -> dict:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                  recursive=True)
+    )
+    out = {"trace_dir": trace_dir, "xplane_files": len(paths), "planes": []}
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            # Device planes only: TPU/GPU op timelines. Host planes hold
+            # python frames / runtime threads — different story.
+            if not re.search(r"TPU|GPU|/device:", plane.name, re.I):
+                continue
+            by_class_ps = collections.Counter()
+            by_op_ps = collections.Counter()
+            for line in plane.lines:
+                # The op timeline only (TPU: "XLA Ops"). "XLA Modules"
+                # spans the sum of its ops and step/TraceMe lines span
+                # whole dispatches — counting any of those alongside the
+                # op events would double the device time.
+                if not re.search(r"ops|stream", line.name, re.I):
+                    continue
+                if re.search(r"module|step|traceme", line.name, re.I):
+                    continue
+                for ev in line.events:
+                    md = plane.event_metadata[ev.metadata_id]
+                    by_class_ps[classify(md.name)] += ev.duration_ps
+                    by_op_ps[md.name] += ev.duration_ps
+            if not by_class_ps:
+                continue
+            total_ps = sum(by_class_ps.values())
+            out["planes"].append({
+                "plane": plane.name,
+                "device_busy_us": round(total_ps / 1e6, 1),
+                "by_class_us": {
+                    c: round(ps / 1e6, 1)
+                    for c, ps in by_class_ps.most_common()
+                },
+                "by_class_share": {
+                    c: round(ps / total_ps, 4)
+                    for c, ps in by_class_ps.most_common()
+                },
+                "top_ops_us": {
+                    n: round(ps / 1e6, 1)
+                    for n, ps in by_op_ps.most_common(15)
+                },
+            })
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default="/tmp/glint_trace_r05")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="steps inside the trace, for us/step derivation")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    doc = summarize(args.trace)
+    if args.steps:
+        doc["steps"] = args.steps
+        for p in doc["planes"]:
+            p["busy_us_per_step"] = round(
+                p["device_busy_us"] / args.steps, 1
+            )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

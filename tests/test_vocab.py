@@ -71,3 +71,90 @@ def test_iter_text_file(tmp_path):
         ["a", "b", "c"],
         ["d", "e"],
     ]
+
+
+# ---------------------------------------------------------------------------
+# Streaming single-pass scan+encode (fit() on generators, no sentence list)
+
+
+def _list_path(sents, min_count, max_len):
+    from glint_word2vec_tpu.corpus.batching import (
+        chunk_sentences, encode_sentences,
+    )
+
+    vocab = build_vocab(sents, min_count=min_count)
+    encoded = chunk_sentences(encode_sentences(sents, vocab), max_len)
+    lens = np.array([s.size for s in encoded], dtype=np.int64)
+    ids = (
+        np.concatenate(encoded).astype(np.int32)
+        if encoded else np.zeros(0, np.int32)
+    )
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return vocab, ids, offsets
+
+
+def test_scan_and_encode_stream_matches_list_path():
+    from glint_word2vec_tpu.corpus.vocab import scan_and_encode_stream
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    sents = [
+        [words[int(j)] for j in rng.zipf(1.5, size=rng.integers(1, 30)) % 40]
+        for _ in range(200)
+    ]
+    sents.append([])  # empty sentence: dropped by both paths
+    sents.append(["only_once"])  # below min_count: OOV-dropped everywhere
+    for min_count, max_len in [(1, 1000), (2, 7), (3, 1)]:
+        v1, i1, o1 = _list_path(sents, min_count, max_len)
+        v2, i2, o2 = scan_and_encode_stream(
+            iter(sents), min_count=min_count, max_sentence_length=max_len
+        )
+        assert v1.words == v2.words  # count-desc rank, first-seen ties
+        assert np.array_equal(v1.counts, v2.counts)
+        assert v1.train_words_count == v2.train_words_count
+        assert np.array_equal(i1, i2)
+        assert np.array_equal(o1, o2)
+
+
+def test_scan_and_encode_stream_tie_order():
+    from glint_word2vec_tpu.corpus.vocab import scan_and_encode_stream
+
+    # b and c tie on count; b was seen first and must rank first, exactly
+    # like build_vocab's stable sort.
+    sents = [["a", "b", "c"], ["a", "b", "c"], ["a"]]
+    v, ids, offs = scan_and_encode_stream(iter(sents), min_count=1)
+    assert v.words == ["a", "b", "c"]
+    assert np.array_equal(ids, [0, 1, 2, 0, 1, 2, 0])
+    assert np.array_equal(offs, [0, 3, 6, 7])
+
+
+def test_fit_generator_matches_fit_list():
+    # The end-to-end guarantee: fit() on a generator trains the SAME
+    # model as fit() on the equivalent list (same vocab, same batches,
+    # same PRNG stream), without materializing the sentence list.
+    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(1)
+    words = [f"t{i}" for i in range(30)]
+    sents = [
+        [words[int(j) % 30] for j in rng.integers(0, 30, rng.integers(3, 12))]
+        for _ in range(120)
+    ]
+
+    def make(src):
+        return Word2Vec(
+            mesh=make_mesh(1, 1), vector_size=16, batch_size=32,
+            min_count=2, num_iterations=1, seed=3, steps_per_call=4,
+        ).fit(src)
+
+    m_list = make(sents)
+    m_gen = make(iter(sents))
+    assert m_list.vocab.words == m_gen.vocab.words
+    np.testing.assert_array_equal(
+        np.asarray(m_list.to_local().vectors),
+        np.asarray(m_gen.to_local().vectors),
+    )
+    m_list.stop()
+    m_gen.stop()
