@@ -37,6 +37,127 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+class _SynonymCoalescer:
+    """Leader-elected micro-batching for the synonym endpoints.
+
+    Device queries are serialized by the server lock, so under N
+    concurrent clients each /synonyms request used to wait for N-1
+    single-query dispatches (QPS flat in N). Here every waiting request
+    lands in a pending list; whichever thread next wins the device lock
+    becomes leader, drains the list, answers ALL of them with ONE
+    ``engine.pull`` + ONE ``find_synonyms_batch`` dispatch (the batch
+    top-k the reference lacks — it loops findSynonyms, ml:375-420), and
+    wakes the waiters. Exclusion semantics match find_synonyms exactly
+    (fetch num+1, drop the query word, truncate).
+
+    Only the base word-level family batches: a subclass overriding
+    ``find_synonyms``/``transform`` (FastText serves OOV words through
+    subwords) keeps its own semantics via the single-query path.
+    """
+
+    def __init__(self, model, device_lock):
+        from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+        self.model = model
+        self.device_lock = device_lock
+        self._mu = threading.Lock()
+        self._pending: list = []
+        self.can_batch = (
+            isinstance(model, Word2VecModel)
+            and type(model).find_synonyms is Word2VecModel.find_synonyms
+            and type(model).transform is Word2VecModel.transform
+        )
+
+    def query(self, word=None, vector=None, num: int = 10):
+        if num <= 0:
+            raise ValueError("num must be > 0")
+        if not self.can_batch:
+            with self.device_lock:
+                if word is not None:
+                    return self.model.find_synonyms(word, num)
+                return self.model.find_synonyms_vector(vector, num)
+        req = {
+            "word": word, "vector": vector, "num": int(num),
+            "event": threading.Event(), "result": None, "error": None,
+        }
+        with self._mu:
+            self._pending.append(req)
+        with self.device_lock:
+            with self._mu:
+                batch, self._pending = self._pending, []
+            if batch:  # empty = an earlier leader already took ours
+                self._process(batch)
+        req["event"].wait()
+        if req["error"] is not None:
+            raise req["error"]
+        return req["result"]
+
+    def _process(self, batch) -> None:
+        m = self.model
+        live = []
+        for r in batch:
+            # Validation failures must fail ONLY their own request: an
+            # exception escaping here would strand every co-batched
+            # waiter on an event that never fires.
+            try:
+                if r["word"] is not None:
+                    i = m.vocab.word_index.get(r["word"])
+                    if i is None:
+                        raise KeyError(
+                            f"word {r['word']!r} not in vocabulary"
+                        )
+                    r["idx"] = i
+                else:
+                    v = np.asarray(r["vector"], dtype=np.float32)
+                    if v.shape != (m.vector_size,):
+                        raise ValueError(
+                            f"vector must have shape ({m.vector_size},), "
+                            f"got {v.shape}"
+                        )
+                    r["vec"] = v
+            except KeyError as e:
+                r["error"] = e
+                r["event"].set()
+                continue
+            except Exception as e:
+                # Anything np.asarray can throw on garbage (TypeError,
+                # ragged-list ValueError) is a bad request, not a 500.
+                r["error"] = ValueError(f"bad vector: {e}")
+                r["event"].set()
+                continue
+            live.append(r)
+        try:
+            if not live:
+                return
+            word_rows = [r for r in live if "idx" in r]
+            if word_rows:
+                pulled = np.asarray(
+                    m.engine.pull(
+                        np.asarray([r["idx"] for r in word_rows], np.int32)
+                    ),
+                    np.float32,
+                )
+                for r, v in zip(word_rows, pulled):
+                    r["vec"] = v
+            k = max(
+                r["num"] + (1 if r["word"] is not None else 0) for r in live
+            )
+            hits = m.find_synonyms_batch(
+                np.stack([r["vec"] for r in live]), min(k, m.vocab.size)
+            )
+            for r, hs in zip(live, hits):
+                if r["word"] is not None:
+                    hs = [(w, s) for w, s in hs if w != r["word"]]
+                r["result"] = hs[: r["num"]]
+        except Exception as e:  # pragma: no cover - device failure path
+            for r in live:
+                if r["error"] is None and r["result"] is None:
+                    r["error"] = e
+        finally:
+            for r in live:
+                r["event"].set()
+
+
 class ModelServer:
     """Holds one loaded model and serves its query surface over HTTP."""
 
@@ -44,8 +165,11 @@ class ModelServer:
         self.model = model
         # Device queries are jitted functions on shared tables; serialize
         # them (the reference's PS likewise processes a shard's requests
-        # on its actor mailbox, one at a time).
+        # on its actor mailbox, one at a time). The synonym endpoints
+        # additionally coalesce concurrent waiters into one batched
+        # dispatch (_SynonymCoalescer).
         self._lock = threading.Lock()
+        self._coalescer = _SynonymCoalescer(model, self._lock)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -82,8 +206,25 @@ class ModelServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
                 try:
-                    with server._lock:
-                        out = server._dispatch(self.path, req)
+                    if self.path == "/synonyms":
+                        out = [
+                            [w, float(s)]
+                            for w, s in server._coalescer.query(
+                                word=req["word"],
+                                num=int(req.get("num", 10)),
+                            )
+                        ]
+                    elif self.path == "/synonyms_vector":
+                        out = [
+                            [w, float(s)]
+                            for w, s in server._coalescer.query(
+                                vector=req["vector"],
+                                num=int(req.get("num", 10)),
+                            )
+                        ]
+                    else:
+                        with server._lock:
+                            out = server._dispatch(self.path, req)
                 except KeyError as e:
                     return self._send(
                         404, {"error": e.args[0] if e.args else str(e)}
@@ -104,17 +245,6 @@ class ModelServer:
 
     def _dispatch(self, path: str, req: dict):
         m = self.model
-        if path == "/synonyms":
-            return [
-                [w, float(s)]
-                for w, s in m.find_synonyms(req["word"], int(req.get("num", 10)))
-            ]
-        if path == "/synonyms_vector":
-            vec = np.asarray(req["vector"], np.float32)
-            return [
-                [w, float(s)]
-                for w, s in m.find_synonyms_vector(vec, int(req.get("num", 10)))
-            ]
         if path == "/analogy":
             return [
                 [w, float(s)]

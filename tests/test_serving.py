@@ -72,3 +72,80 @@ def test_error_paths(served):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(server, "/nosuchroute", {})
     assert e.value.code == 404
+
+
+def test_concurrent_synonyms_coalesced_match_sequential(served):
+    # The coalescer (serving._SynonymCoalescer) answers concurrent
+    # synonym queries with one batched dispatch; results must be
+    # identical to sequential single queries, mixed num values and OOV
+    # errors included.
+    import threading
+
+    server, model = served
+    words = [model.vocab.words[i] for i in range(6)]
+    jobs = (
+        [("/synonyms", {"word": w, "num": 3 + (i % 3)})
+         for i, w in enumerate(words)]
+        + [("/synonyms", {"word": "notaword_xyz", "num": 5})]
+        + [("/synonyms_vector",
+            {"vector": [float(x) for x in model.transform(words[0])],
+             "num": 4})]
+    )
+    results = [None] * len(jobs)
+    errors = [None] * len(jobs)
+
+    def hit(i, path, payload):
+        try:
+            results[i] = _post(server, path, payload)
+        except urllib.error.HTTPError as e:
+            errors[i] = e.code
+
+    threads = [
+        threading.Thread(target=hit, args=(i, p, pl))
+        for i, (p, pl) in enumerate(jobs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+
+    for i, w in enumerate(words):
+        expect = model.find_synonyms(w, 3 + (i % 3))
+        assert results[i] is not None
+        assert [x[0] for x in results[i]] == [x[0] for x in expect]
+        np.testing.assert_allclose(
+            [x[1] for x in results[i]], [x[1] for x in expect], rtol=1e-5
+        )
+    assert errors[len(words)] == 404  # OOV inside a coalesced batch
+    vec_expect = model.find_synonyms_vector(model.transform(words[0]), 4)
+    assert [x[0] for x in results[-1]] == [x[0] for x in vec_expect]
+
+
+def test_malformed_vector_fails_only_its_own_request(served):
+    # A garbage /synonyms_vector payload inside a coalesced batch must
+    # 400 by itself without stranding co-batched waiters.
+    import threading
+
+    server, model = served
+    ok_res, bad_code = [], []
+
+    def good():
+        ok_res.append(
+            _post(server, "/synonyms", {"word": model.vocab.words[0],
+                                        "num": 3})
+        )
+
+    def bad():
+        try:
+            _post(server, "/synonyms_vector",
+                  {"vector": ["a", "b"], "num": 3})
+        except urllib.error.HTTPError as e:
+            bad_code.append(e.code)
+
+    ts = [threading.Thread(target=good), threading.Thread(target=bad)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert bad_code == [400]
+    assert len(ok_res) == 1 and len(ok_res[0]) == 3
