@@ -101,25 +101,28 @@ def _config_from_env():
     }
 
 
-def _flops_per_step(mode: str, cfg) -> float:
-    """Analytic FLOPs of one minibatch update (matmul-equivalent count).
+def _flops_per_step(mode: str, cfg, mask_density: float) -> float:
+    """USEFUL FLOPs of one minibatch update (matmul-equivalent count).
 
     Per-pair (ops/sgns.py sgns_grads + rank-1 expansion): f_pos 2BCd,
     f_neg 2BCnd, d_center 2BCd+2BCnd, outer products BCd+BCnd, scatter adds
     BCd+BCnd+Bd  => ~6BCd(1+n) + Bd.
     Shared pool (shared_sgns_grads): f_pos 2BCd, f_pool 2BSd, d_center
     2BCd+2BSd, d_pool 2BSd, outer+scatter 2BCd+Bd+Sd => ~6BCd + 6BSd.
+
+    Every context-lane term is scaled by the mode's MEASURED mask
+    density: the MXU executes all C static lanes either way, but masked
+    lanes do no useful work, so crediting them would inflate MFU — and
+    inflate it unevenly (synthetic masks run ~0.85 dense, the corpus
+    mode's shrunk windows ~0.5-0.6; round-4 verdict weak #8). The MFU
+    reported is therefore useful-work MFU on a consistent basis.
     """
     B, C, d, n = cfg["batch"], cfg["context_lanes"], cfg["dim"], cfg["negatives"]
     estimator, _, _ = _mode_parts(mode)
     if estimator in ("per_pair", "corpus"):
-        # corpus mode runs the per-pair step on device-assembled windows;
-        # its true mask density is the shrunk-window average (~0.57 of the
-        # lanes) vs the 0.85 synthetic masks, so like every other mode
-        # this FLOPs figure is an upper-bound estimate.
-        return 6.0 * B * C * d * (1 + n) + B * d
+        return 6.0 * B * C * d * (1 + n) * mask_density + B * d
     S = cfg["shared_negatives"]
-    return 6.0 * B * C * d + 6.0 * B * S * d + B * d + S * d
+    return 6.0 * B * C * d * mask_density + 6.0 * B * S * d + B * d + S * d
 
 
 # ----------------------------------------------------------------------
@@ -174,6 +177,11 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     centers_k = rng.choice(V, size=(spc, B), p=p).astype(np.int32)
     contexts_k = rng.choice(V, size=(spc, B, C), p=p).astype(np.int32)
     mask_k = (rng.random((spc, B, C)) < 0.85).astype(np.float32)
+    # Measured mask density for useful-FLOPs accounting, taken from the
+    # host copy BEFORE device_put: pulling the full mask back later
+    # would re-pay the device->host transfer the device-input path
+    # exists to avoid.
+    density = float(mask_k.mean())
     alphas = np.full(spc, 0.025, np.float32)
     host_inputs = bool(int(os.environ.get("BENCH_HOST_INPUTS", "0")))
     if not host_inputs:
@@ -216,13 +224,14 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     steps = calls * spc
     words = B * steps  # trained center positions == reference word count
     wps = words / dt
-    flops = _flops_per_step(mode, cfg) * steps / dt
+    flops = _flops_per_step(mode, cfg, density) * steps / dt
     del eng  # release the two V x d tables before the next mode runs
     return {
         "words_per_sec": round(wps, 1),
         "step_time_us": round(dt / steps * 1e6, 1),
         "compile_s": round(compile_s, 1),
         "flops_per_sec": round(flops, 3),
+        "mask_density": round(density, 4),
         "timed_steps": steps,
         # Effective dtypes for THIS mode (suffixes override BENCH_DTYPE),
         # so the artifact is self-describing.
@@ -274,13 +283,30 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
 
     steps = calls * spc
     words = B * steps
+
+    # MEASURED mask density of the device-assembled windows (the shrink
+    # draw + sentence bounds leave ~0.5-0.6 of the lanes live): evaluate
+    # the actual batcher on one dispatch's worth of positions.
+    from glint_word2vec_tpu.ops.device_batching import device_window_batch
+
+    jnp = jax.numpy
+    _, _, probe_mask = device_window_batch(
+        jnp.asarray(ids),
+        jnp.asarray(offsets.astype(np.int32)),
+        jnp.arange(spc * B, dtype=jnp.int32),
+        jnp.arange(spc * B, dtype=jnp.int32),
+        key, W,
+    )
+    density = float(np.asarray(probe_mask).mean())
+    del probe_mask
     return {
         "words_per_sec": round(words / dt, 1),
         "step_time_us": round(dt / steps * 1e6, 1),
         "compile_s": round(compile_s, 1),
         "flops_per_sec": round(
-            _flops_per_step("corpus", cfg) * steps / dt, 3
+            _flops_per_step("corpus", cfg, density) * steps / dt, 3
         ),
+        "mask_density": round(density, 4),
         "timed_steps": steps,
         "table_dtype": str(eng.syn0.dtype),
         "compute_dtype": compute_dtype,

@@ -70,6 +70,11 @@ class _SynonymCoalescer:
 
     def query(self, word=None, vector=None, num: int = 10):
         if num <= 0:
+            # Exact pre-coalescer behavior: find_synonyms(w, 0) returned
+            # [] (it truncates after fetching num+1), while
+            # find_synonyms_vector raises -> 400.
+            if word is not None:
+                return []
             raise ValueError("num must be > 0")
         if not self.can_batch:
             with self.device_lock:
@@ -82,11 +87,17 @@ class _SynonymCoalescer:
         }
         with self._mu:
             self._pending.append(req)
-        with self.device_lock:
-            with self._mu:
-                batch, self._pending = self._pending, []
-            if batch:  # empty = an earlier leader already took ours
-                self._process(batch)
+        # Leaders set every batched event BEFORE releasing the device
+        # lock, so a waiter whose result is already in hand must not
+        # queue behind the next leader's whole dispatch (lock convoy —
+        # it showed up as a 7x p95 inflation at 16 clients).
+        if not req["event"].is_set():
+            with self.device_lock:
+                if not req["event"].is_set():
+                    with self._mu:
+                        batch, self._pending = self._pending, []
+                    if batch:
+                        self._process(batch)
         req["event"].wait()
         if req["error"] is not None:
             raise req["error"]

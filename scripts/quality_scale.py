@@ -60,13 +60,17 @@ def build_corpus(target_words: int, path: str, seed: int = 0) -> int:
     lens = np.array([len(ln.split()) for ln in lines], dtype=np.int64)
     rng = np.random.default_rng(seed)
     total = 0
-    with open(path, "w", encoding="utf-8") as f:
+    tmp = path + ".building"
+    with open(tmp, "w", encoding="utf-8") as f:
         while total < target_words:
             for i in rng.integers(0, len(lines), 4096):
                 f.write(lines[int(i)] + "\n")
                 total += int(lens[int(i)])
                 if total >= target_words:
                     break
+    # Atomic: a run killed mid-build must never leave a partial corpus
+    # that a later run's existence check would silently reuse.
+    os.replace(tmp, path)
     return total
 
 

@@ -1,0 +1,17 @@
+# TIMEOUT=420
+python - <<'PY' > PROBE_r05_hello.json
+import json, time
+import jax, jax.numpy as jnp
+t0 = time.time()
+d = jax.devices()[0]
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+jax.block_until_ready(x @ x)
+doc = {"metric": "hello_chip", "platform": d.platform,
+       "device_kind": d.device_kind, "init_plus_matmul_s": round(time.time()-t0, 1)}
+try:
+    doc["memory_stats"] = {k: int(v) for k, v in (d.memory_stats() or {}).items()
+                           if isinstance(v, (int, float))}
+except Exception as e:
+    doc["memory_stats_error"] = str(e)
+print(json.dumps(doc))
+PY
