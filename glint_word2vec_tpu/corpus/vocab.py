@@ -199,6 +199,11 @@ def encode_file(
     return flat, offsets
 
 
+#: Token-buffer flush threshold for the streaming scan (module-level so
+#: tests can shrink it to exercise multi-block concatenation).
+_STREAM_BLOCK = 1 << 20
+
+
 def scan_and_encode_stream(
     sentences: Iterable[Sequence[str]],
     min_count: int = 5,
@@ -226,7 +231,7 @@ def scan_and_encode_stream(
     id_blocks: List[np.ndarray] = []
     buf: List[int] = []
     sent_lens: List[int] = []
-    BLOCK = 1 << 20
+    BLOCK = _STREAM_BLOCK
     for sentence in sentences:
         n = 0
         for w in sentence:

@@ -69,18 +69,26 @@ class _SynonymCoalescer:
         )
 
     def query(self, word=None, vector=None, num: int = 10):
-        if num <= 0:
-            # Exact pre-coalescer behavior: find_synonyms(w, 0) returned
-            # [] (it truncates after fetching num+1), while
-            # find_synonyms_vector raises -> 400.
-            if word is not None:
-                return []
-            raise ValueError("num must be > 0")
         if not self.can_batch:
+            # Overriding families define their own semantics end to end
+            # (FastText OOV-by-subwords, its own num validation).
             with self.device_lock:
                 if word is not None:
                     return self.model.find_synonyms(word, num)
                 return self.model.find_synonyms_vector(vector, num)
+        if num <= 0:
+            # Exact single-query behavior for the base family.
+            # find_synonyms(w, num): transform(w) runs FIRST (OOV ->
+            # KeyError -> 404), then find_synonyms_vector(vec, num+1)
+            # raises unless num+1 > 0 — so num=0 with a known word is []
+            # (truncation) and num<0 is a 400. The bare vector endpoint
+            # always raises on num<=0.
+            if word is not None:
+                if word not in self.model.vocab.word_index:
+                    raise KeyError(f"word {word!r} not in vocabulary")
+                if num == 0:
+                    return []
+            raise ValueError("num must be > 0")
         req = {
             "word": word, "vector": vector, "num": int(num),
             "event": threading.Event(), "result": None, "error": None,

@@ -73,3 +73,24 @@ def test_flops_scale_with_measured_mask_density():
     sh_half = bench._flops_per_step("shared", cfg, 0.5)
     pool_terms = 6.0 * 8 * 16 * 4 + 8 * 4 + 16 * 4
     assert sh_half == (sh_full - pool_terms) / 2 + pool_terms
+
+
+def test_trace_summarize_op_classes():
+    spec2 = importlib.util.spec_from_file_location(
+        "trace_summarize", os.path.join(ROOT, "scripts", "trace_summarize.py")
+    )
+    ts = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(ts)
+    cases = {
+        "all-reduce.1": "collective",
+        "dynamic-update-slice.7": "scatter",
+        "gather.2": "gather",
+        "dot_general": "dense_mxu",
+        "rng-bit-generator": "rng_sampling",
+        "copy.3": "data_movement",
+        "infeed": "host_transfer",
+        "fusion.12": "fusion_other",
+        "custom-call.9": "other",
+    }
+    for name, want in cases.items():
+        assert ts.classify(name) == want, (name, ts.classify(name))

@@ -149,3 +149,22 @@ def test_malformed_vector_fails_only_its_own_request(served):
         t.join(timeout=30)
     assert bad_code == [400]
     assert len(ok_res) == 1 and len(ok_res[0]) == 3
+
+
+def test_num_zero_and_negative_match_single_query_semantics(served):
+    server, model = served
+    w = model.vocab.words[0]
+    # num=0 with a known word: 200 [] (find_synonyms truncation).
+    assert _post(server, "/synonyms", {"word": w, "num": 0}) == []
+    # num=0 with an OOV word: transform runs first -> 404.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms", {"word": "notaword_xyz", "num": 0})
+    assert e.value.code == 404
+    # Negative num: 400 either way.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms", {"word": w, "num": -1})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms_vector",
+              {"vector": [0.0] * model.vector_size, "num": 0})
+    assert e.value.code == 400

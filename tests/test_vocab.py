@@ -158,3 +158,22 @@ def test_fit_generator_matches_fit_list():
     )
     m_list.stop()
     m_gen.stop()
+
+
+def test_scan_and_encode_stream_block_flush(monkeypatch):
+    # Shrink the flush threshold so the stream spans many id blocks;
+    # the multi-block concatenation must be invisible in the output.
+    from glint_word2vec_tpu.corpus import vocab as vmod
+
+    rng = np.random.default_rng(2)
+    words = [f"w{i}" for i in range(20)]
+    sents = [
+        [words[int(j)] for j in rng.integers(0, 20, rng.integers(1, 9))]
+        for _ in range(300)
+    ]
+    v1, i1, o1 = vmod.scan_and_encode_stream(iter(sents), min_count=1)
+    monkeypatch.setattr(vmod, "_STREAM_BLOCK", 16)
+    v2, i2, o2 = vmod.scan_and_encode_stream(iter(sents), min_count=1)
+    assert v1.words == v2.words
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(o1, o2)
