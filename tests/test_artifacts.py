@@ -66,3 +66,9 @@ def test_root_artifacts_mark_fallback():
                 f"{os.path.basename(path)} records platform={platform!r} "
                 "without a top-level fallback marker"
             )
+
+
+def test_quality_scale_meets_control():
+    q = _load("QUALITY_SCALE.json")
+    assert q["corpus_words"] >= 10_000_000, q["corpus_words"]
+    assert q["summary"]["meets_control"] is True, q["summary"]
