@@ -17,7 +17,8 @@ clock effects don't flatter small scan lengths, across:
                  two interesting dtypes
 
 Usage: python scripts/dtype_scan_probe.py [--out FILE]
-Knobs: PROBE_SUSTAIN_S, PROBE_SPCS, PROBE_VOCAB, GLINT_PROFILE_PLATFORM.
+Knobs: PROBE_SUSTAIN_S, PROBE_SPCS, PROBE_VOCAB, PROBE_BATCH,
+GLINT_PROFILE_PLATFORM.
 """
 
 import argparse
@@ -36,7 +37,8 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 V = int(os.environ.get("PROBE_VOCAB", 1_000_000))
-d, B, C, n = 300, 8192, 7, 5
+B = int(os.environ.get("PROBE_BATCH", 8192))
+d, C, n = 300, 7, 5
 SUSTAIN_S = float(os.environ.get("PROBE_SUSTAIN_S", 2.0))
 SPCS = tuple(
     int(s) for s in os.environ.get("PROBE_SPCS", "4,16,32").split(",")

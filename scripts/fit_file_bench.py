@@ -91,6 +91,9 @@ def main():
     out = {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
+        # Stage stdout is redirected into a root artifact; a non-TPU run
+        # must self-mark (tests/test_artifacts.py hygiene rule).
+        **({} if dev.platform == "tpu" else {"fallback": dev.platform}),
         "corpus_words": actual_words,
         "distinct_tokens": V,
         "batch": B,
