@@ -31,11 +31,24 @@ EOF
 }
 
 wait_alive() {
-  until probe_alive; do
-    echo "chip unreachable $(date -u +%FT%TZ)" >> "$L"
-    sleep 45
+  # Overlapping probes: a single sequential probe blocks up to 240s
+  # against a dead tunnel, so a short live window (round 4 saw ~3 min)
+  # could open and close entirely between probes. Launch a fresh probe
+  # every 60s instead; whichever one lands while the chip is up touches
+  # the flag, so detection lags the chip by ~init time + <=60s. The
+  # flag carries a per-call nonce so a stale probe from a PREVIOUS
+  # wait_alive can never mark a dead chip alive for the next stage.
+  WAIT_NONCE=$((${WAIT_NONCE:-0} + 1))
+  local flag=/tmp/q5_alive_$$_$WAIT_NONCE
+  rm -f "$flag"
+  until [ -e "$flag" ]; do
     [ -e "$Q/STOP" ] && return 1
+    ( probe_alive && : > "$flag" ) &
+    local w=0
+    while [ "$w" -lt 60 ] && [ ! -e "$flag" ]; do sleep 5; w=$((w+5)); done
+    echo "probe tick $(date -u +%FT%TZ)" >> "$L"
   done
+  rm -f "$flag"
   echo "chip ALIVE $(date -u +%FT%TZ)" >> "$L"
   return 0
 }
@@ -50,7 +63,18 @@ run_stage() {
   echo "--- stage $f (timeout ${to}s) $(date -u +%FT%TZ)" >> "$L"
   timeout "$to" bash "$f" > "$base.log" 2>&1
   local rc=$?
-  echo "rc=$rc $(date -u +%FT%TZ)" > "$base.done"
+  if [ "$rc" -eq 0 ]; then
+    echo "rc=0 $(date -u +%FT%TZ)" > "$base.done"
+  elif [ -e "$base.fail1" ]; then
+    # Second failure: park it so a genuinely-broken stage can't starve
+    # the stages behind it.
+    echo "rc=$rc after retry $(date -u +%FT%TZ)" > "$base.done"
+  else
+    # First failure (often a mid-stage tunnel death): leave it pending
+    # for ONE retry at the next ALIVE instead of permanently skipping a
+    # measurement that produced nothing.
+    echo "rc=$rc $(date -u +%FT%TZ)" > "$base.fail1"
+  fi
   echo "stage $f rc=$rc $(date -u +%FT%TZ)" >> "$L"
 }
 
