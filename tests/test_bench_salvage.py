@@ -75,12 +75,17 @@ def test_flops_scale_with_measured_mask_density():
     assert sh_half == (sh_full - pool_terms) / 2 + pool_terms
 
 
-def test_trace_summarize_op_classes():
+def _load_trace_summarize():
     spec2 = importlib.util.spec_from_file_location(
         "trace_summarize", os.path.join(ROOT, "scripts", "trace_summarize.py")
     )
     ts = importlib.util.module_from_spec(spec2)
     spec2.loader.exec_module(ts)
+    return ts
+
+
+def test_trace_summarize_op_classes():
+    ts = _load_trace_summarize()
     cases = {
         "all-reduce.1": "collective",
         "dynamic-update-slice.7": "scatter",
@@ -94,3 +99,60 @@ def test_trace_summarize_op_classes():
     }
     for name, want in cases.items():
         assert ts.classify(name) == want, (name, ts.classify(name))
+
+
+def test_trace_summarize_device_plane_aggregation(tmp_path):
+    # Synthetic xplane with the TPU trace shape: a device plane carrying
+    # an "XLA Ops" line (must aggregate) plus spanning lines that must be
+    # EXCLUDED — "XLA Modules"/"Steps" (fail the ops|stream inclusion)
+    # AND a "Steps Ops" line that MATCHES the inclusion regex and is only
+    # kept out by the module|step|traceme exclusion — plus a host plane
+    # (ignored). Counting any spanning line would double the device time.
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    ts = _load_trace_summarize()
+
+    xs = xplane_pb2.XSpace()
+    dev = xs.planes.add(name="/device:TPU:0")
+
+    def add_line(plane, name, events):  # events: [(op_name, dur_ps)]
+        line = plane.lines.add(name=name)
+        for op, dur in events:
+            mid = len(plane.event_metadata) + 1
+            plane.event_metadata[mid].id = mid
+            plane.event_metadata[mid].name = op
+            ev = line.events.add(metadata_id=mid)
+            ev.duration_ps = dur
+
+    add_line(dev, "XLA Ops", [
+        ("fusion.1", 3_000_000),          # 3 us -> fusion_other
+        ("dot_general.2", 2_000_000),     # dense_mxu
+        ("dynamic-update-slice.3", 1_000_000),  # scatter
+        ("all-reduce.4", 500_000),        # collective
+    ])
+    add_line(dev, "XLA Modules", [("jit_train", 6_500_000)])
+    add_line(dev, "Steps", [("step0", 6_500_000)])
+    # Matches the inclusion regex ("ops") — only the exclusion branch
+    # keeps this spanning line out of the aggregate.
+    add_line(dev, "Steps Ops", [("step0_span", 6_500_000)])
+    host = xs.planes.add(name="/host:CPU")
+    add_line(host, "python", [("frame", 9_000_000)])
+
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    (d / "vm.xplane.pb").write_bytes(xs.SerializeToString())
+
+    doc = ts.summarize(str(tmp_path))
+    assert len(doc["planes"]) == 1
+    p = doc["planes"][0]
+    assert p["plane"] == "/device:TPU:0"
+    assert p["device_busy_us"] == 6.5  # ops only, no module/step double-count
+    assert p["by_class_us"] == {
+        "fusion_other": 3.0, "dense_mxu": 2.0, "scatter": 1.0,
+        "collective": 0.5,
+    }
+    assert abs(p["by_class_share"]["fusion_other"] - 3.0 / 6.5) < 1e-3
