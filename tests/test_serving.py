@@ -13,11 +13,13 @@ from glint_word2vec_tpu.parallel.mesh import make_mesh
 from glint_word2vec_tpu.serving import ModelServer
 
 
-@pytest.fixture(scope="module")
-def served(tiny_corpus):
+@pytest.fixture(scope="module", params=["rows", "dims"])
+def served(request, tiny_corpus):
+    # Both model-axis layouts behind the same HTTP surface: every serving
+    # test (coalescing, error paths, num semantics) runs against each.
     model = Word2Vec(
         mesh=make_mesh(1, 2), vector_size=16, min_count=5, batch_size=128,
-        seed=2, num_iterations=2,
+        seed=2, num_iterations=2, layout=request.param,
     ).fit(tiny_corpus)
     server = ModelServer(model, port=0)  # ephemeral port
     server.start_background()
