@@ -537,7 +537,6 @@ def main() -> None:
             # Dead backend: don't burn the attempt budget against it.
             attempts.append({"attempt": "liveness-probe", "error": err})
             plans = []
-    if os.environ.get("BENCH_PLATFORM", "") != "cpu":
         # Separate partial path so a CPU fallback run never clobbers the
         # accelerator attempts' salvageable per-mode results.
         cpu_env = dict(base_env, BENCH_PLATFORM="cpu", BENCH_PARTIAL=cpu_path)

@@ -231,7 +231,6 @@ def scan_and_encode_stream(
     id_blocks: List[np.ndarray] = []
     buf: List[int] = []
     sent_lens: List[int] = []
-    BLOCK = _STREAM_BLOCK
     for sentence in sentences:
         n = 0
         for w in sentence:
@@ -246,7 +245,7 @@ def scan_and_encode_stream(
             n += 1
         if n:
             sent_lens.append(n)
-        if len(buf) >= BLOCK:
+        if len(buf) >= _STREAM_BLOCK:
             id_blocks.append(np.asarray(buf, dtype=np.int32))
             buf = []
     if buf:

@@ -74,15 +74,8 @@ def build_corpus(target_words: int, path: str, seed: int = 0) -> int:
     return total
 
 
-def _mean_sd(xs):
-    n = len(xs)
-    mean = sum(xs) / n
-    sd = (sum((x - mean) ** 2 for x in xs) / max(n - 1, 1)) ** 0.5
-    return round(mean, 4), round(sd, 4)
-
-
 def main():
-    from reference_quality import analogy_questions, gates
+    from reference_quality import _mean_sd, analogy_questions, gates
 
     from glint_word2vec_tpu import Word2Vec
     from glint_word2vec_tpu.eval import evaluate_analogies
