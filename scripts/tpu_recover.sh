@@ -1,56 +1,8 @@
 #!/bin/bash
-# Tunnel-recovery watcher: poll until the chip answers a tiny op, then run
-# the round-4 measurement queue in priority order, re-probing aliveness
-# between stages so a mid-queue tunnel death doesn't burn every later
-# stage's timeout against a dead link. Safe to leave running; exits after
-# one full pass. Log: /tmp/tpu_recover.log
-set -u
-L="${1:-/tmp/tpu_recover.log}"
-cd "$(dirname "$0")/.." || exit 1
-echo "=== tpu_recover start $(date) ===" >> "$L"
-
-probe_alive() {
-  # First device init over the tunnel can exceed 120s — a short timeout
-  # here would kill every probe mid-init and spin forever.
-  timeout 240 python - <<'EOF' >/dev/null 2>&1
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256))
-assert float((x @ x).sum()) > 0
-EOF
-}
-
-wait_alive() {
-  until probe_alive; do
-    echo "chip unreachable $(date)" >> "$L"
-    sleep 30
-  done
-  echo "chip ALIVE $(date)" >> "$L"
-}
-
-stage() {  # stage NAME TIMEOUT CMD...
-  local name="$1" to="$2"; shift 2
-  wait_alive
-  echo "--- $name $(date)" >> "$L"
-  timeout "$to" "$@" >> "$L" 2>&1
-  echo "$name rc=$?" >> "$L"
-}
-
-stage dtype_scan_probe 1200 \
-  python scripts/dtype_scan_probe.py --out PROBE_r04_dtype_scan.json
-
-stage bench 900 \
-  bash -c 'python bench.py > BENCH_r04_prelim.json'
-
-stage scale_test 1800 \
-  bash -c 'python scripts/scale_test.py > /tmp/scale_tpu2.json'
-
-stage fit_file_bench 1500 \
-  env FITBENCH_WORDS=10000000 FITBENCH_CORPUS=/tmp/fitbench_10m.txt \
-  bash -c 'python scripts/fit_file_bench.py > FITFILE_r04.json'
-
-stage bench_sweep 2400 python scripts/bench_sweep.py
-
-stage pallas_retry 600 \
-  bash -c 'python scripts/pallas_bench.py > PALLAS_r04.json'
-
-echo "=== tpu_recover done $(date) ===" >> "$L"
+# SUPERSEDED (round 5): the round-4 sequential-probe recovery queue is
+# replaced by scripts/run_queue_r05.sh + scripts/queue_r05/ — overlapping
+# 60s liveness probes (a sequential 240s probe could sleep through a
+# short tunnel window), file-based appendable stages with .done markers,
+# and one retry per failed stage. This stub delegates so stale launchers
+# can't run the old artifact names or double-drain the queue.
+exec bash "$(dirname "$0")/run_queue_r05.sh" "$@"
