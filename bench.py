@@ -26,9 +26,13 @@ diagnostic JSON line. It always exits 0 with one JSON line on stdout.
 Environment knobs:
   BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_SPC (minibatches per device
   dispatch = scan length), BENCH_SHARED_NEG (pool size for the shared mode),
-  BENCH_MODES (default "per_pair,per_pair_bf16ct,shared_bf16ct,corpus" —
+  BENCH_MODES (default
+  "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,corpus_subsample" —
   "corpus" is the production fit/fit_file path with minibatches assembled
-  on device from the uploaded corpus; suffixes:
+  on device from the uploaded corpus; "corpus_subsample" is the same path
+  with frequency subsampling on (ratio BENCH_SUBSAMPLE, default 1e-3):
+  a per-epoch on-device compaction pass, then training over the
+  compacted stream — the realistic production config; suffixes:
   "_bf16c" = bf16 MXU operands with f32 accumulation, "_bf16t" = bf16
   TABLES for that mode (overriding BENCH_DTYPE; halves gather/scatter
   bytes), "_bf16ct" = both), BENCH_DTYPE (run-level table dtype, default
@@ -80,6 +84,7 @@ def _config_from_env():
         "batch": int(os.environ.get("BENCH_BATCH", 8192)),
         "steps_per_call": int(os.environ.get("BENCH_SPC", 32)),
         "shared_negatives": int(os.environ.get("BENCH_SHARED_NEG", 4096)),
+        "subsample_ratio": float(os.environ.get("BENCH_SUBSAMPLE", 1e-3)),
         "negatives": 5,
         "context_lanes": 7,
         # Table dtype defaults to float32 so the per_pair headline stays
@@ -93,10 +98,13 @@ def _config_from_env():
         # shared (pool estimator), corpus (the PRODUCTION fit/fit_file
         # path: minibatch windows assembled ON DEVICE from the uploaded
         # corpus — includes the window-assembly cost the other modes
-        # skip). Defaults: the r03-comparable headline + the per-pair
-        # fast path + the fastest estimator config + the production path.
+        # skip), corpus_subsample (corpus + the per-epoch on-device
+        # subsample-compact pass — the realistic production config).
+        # Defaults: the r03-comparable headline + the per-pair fast path
+        # + the fastest estimator config + both production paths.
         "modes": os.environ.get(
-            "BENCH_MODES", "per_pair,per_pair_bf16ct,shared_bf16ct,corpus"
+            "BENCH_MODES",
+            "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,corpus_subsample",
         ),
     }
 
@@ -119,7 +127,7 @@ def _flops_per_step(mode: str, cfg, mask_density: float) -> float:
     """
     B, C, d, n = cfg["batch"], cfg["context_lanes"], cfg["dim"], cfg["negatives"]
     estimator, _, _ = _mode_parts(mode)
-    if estimator in ("per_pair", "corpus"):
+    if estimator in ("per_pair", "corpus", "corpus_subsample"):
         return 6.0 * B * C * d * (1 + n) * mask_density + B * d
     S = cfg["shared_negatives"]
     return 6.0 * B * C * d * mask_density + 6.0 * B * S * d + B * d + S * d
@@ -167,8 +175,11 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     )
 
     p = (counts / counts.sum()).astype(np.float64)
-    if estimator == "corpus":
-        return _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p)
+    if estimator in ("corpus", "corpus_subsample"):
+        return _bench_corpus_mode(
+            jax, eng, cfg, np, compute_dtype, p,
+            subsample=(estimator == "corpus_subsample"),
+        )
 
     rng = np.random.default_rng(0)
     # Zipf-distributed center/context draws (the hot rows dominate, as in
@@ -241,11 +252,13 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     }
 
 
-def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
+def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p, subsample=False):
     """The production fit/fit_file hot path: the flat Zipf corpus uploaded
     to HBM once, every minibatch assembled INSIDE the jitted train scan
     (ops/device_batching window shrinkage + sentence bounds); per-dispatch
-    host->device traffic is scalars only."""
+    host->device traffic is scalars only. With ``subsample`` the per-epoch
+    on-device subsample-compact pass runs first (the realistic production
+    config) and training covers the compacted stream."""
     V, B, spc = cfg["vocab"], cfg["batch"], cfg["steps_per_call"]
     # Window sized so the device batcher's lane count (2W-3) matches the
     # context_lanes the FLOPs formula charges.
@@ -258,6 +271,21 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
     ids = rng.choice(V, size=N, p=p).astype(np.int32)
     offsets = np.arange(0, N + sent_len, sent_len, dtype=np.int64)
     eng.upload_corpus(ids, offsets)
+    ratio = cfg["subsample_ratio"]
+    n_pos = N
+    compact_s = None
+    if subsample:
+        # Per-word keep probabilities by the exact Vocabulary
+        # .keep_probabilities rule; ``p`` IS the normalized frequency the
+        # Zipf corpus was drawn from, so no vocab scan is needed.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            kp = (np.sqrt(p / ratio) + 1.0) * (ratio / p)
+        kp = np.clip(np.where(p > 0, kp, 0.0), 0.0, 1.0).astype(np.float32)
+        eng.set_keep_probs(kp)
+        eng.compact_corpus(jax.random.PRNGKey(1))  # compile warm-up
+        t0 = time.time()
+        n_pos = eng.compact_corpus(jax.random.PRNGKey(2))
+        compact_s = time.time() - t0  # steady-state per-epoch cost
     alphas = np.full(spc, 0.025, np.float32)
     key = jax.random.PRNGKey(0)
 
@@ -268,13 +296,16 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
 
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", 2.0))
     max_calls = int(os.environ.get("BENCH_MAX_CALLS", 50))
-    span = max(N - spc * B, 1)  # wrap so no dispatch hits the epoch tail
+    span = max(n_pos - spc * B, 1)  # wrap so no dispatch hits the epoch tail
     t0 = time.time()
-    calls, last = 0, None
+    calls, last, words = 0, None, 0
     while calls < max_calls:
-        last = eng.train_steps_corpus(
-            (calls * spc * B) % span, B, W, key, alphas, calls * spc
-        )
+        start = (calls * spc * B) % span
+        last = eng.train_steps_corpus(start, B, W, key, alphas, calls * spc)
+        # Credit only LIVE positions: an aggressive ratio can compact
+        # n_pos below one dispatch's coverage, and the tail rows past
+        # n_pos are zero-mask no-ops that must not count as trained words.
+        words += max(0, min(n_pos, start + spc * B) - start)
         calls += 1
         if calls >= 2 and time.time() - t0 >= min_seconds:
             break
@@ -282,7 +313,6 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
     dt = time.time() - t0
 
     steps = calls * spc
-    words = B * steps
 
     # MEASURED mask density of the device-assembled windows (shrink draw
     # + sentence-bound clipping leave ~0.42 of the lanes live at W=5:
@@ -293,16 +323,21 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
     from glint_word2vec_tpu.ops.device_batching import device_window_batch
 
     jnp = jax.numpy
-    dev_ids, dev_offsets = eng._corpus
+    # Probe the ACTIVE corpus view (the compacted buffers when
+    # subsampling): its shrunk-window density is what the scan executed.
+    dev_ids, dev_offsets = (
+        eng._corpus_compacted if subsample else eng._corpus
+    )
     _, _, probe_mask = device_window_batch(
         dev_ids, dev_offsets,
         jnp.arange(B, dtype=jnp.int32),
         jnp.arange(B, dtype=jnp.int32),
         key, W,
+        n_valid=jnp.int32(n_pos),
     )
     density = float(np.asarray(probe_mask).mean())
     del probe_mask
-    return {
+    out = {
         "words_per_sec": round(words / dt, 1),
         "step_time_us": round(dt / steps * 1e6, 1),
         "compile_s": round(compile_s, 1),
@@ -317,6 +352,14 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p):
         "window": W,
         "inputs": "device_corpus",
     }
+    if subsample:
+        # The effective ratio + what it kept, so the JSON line is
+        # self-describing about what the words/sec number trained over.
+        out["subsample_ratio"] = ratio
+        out["corpus_words_kept"] = int(n_pos)
+        out["kept_fraction"] = round(n_pos / N, 4)
+        out["compact_s"] = round(compact_s, 3)
+    return out
 
 
 def worker_main() -> None:

@@ -101,6 +101,14 @@ class Vocabulary:
         ran = np.where(self.counts > 0, ran, 0.0)
         return np.clip(ran, 0.0, 1.0)
 
+    def device_keep_probabilities(self, subsample_ratio: float) -> np.ndarray:
+        """:meth:`keep_probabilities` shaped for the device subsampling
+        pass (ops/device_batching.subsample_keep_mask): float32, one
+        entry per vocab row, indexable by the flat corpus ids. The f64
+        -> f32 rounding moves each threshold by <= 6e-8 relative — far
+        below the statistical resolution of any kept-fraction gate."""
+        return self.keep_probabilities(subsample_ratio).astype(np.float32)
+
     def encode(self, sentence: Sequence[str]) -> np.ndarray:
         """Map words to indices, silently dropping OOV words.
 

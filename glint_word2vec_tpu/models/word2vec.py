@@ -314,13 +314,15 @@ class Word2Vec:
 
     def _device_corpus_eligible(self, corpus_words: int = 0) -> bool:
         """Whether the device-resident corpus path applies: word-level
-        centers (subword grouping overrides this to False), no frequency
-        subsampling (it compacts sentences before windowing — a dynamic
-        reshape the static-shape device batcher does not express; see
-        ops/device_batching), the corpus fits the HBM budget reserved
-        for it (4 bytes/word replicated per device; tables need the
-        rest — GLINT_DEVICE_CORPUS_MAX_BYTES overrides the 2 GiB
-        default), and no env escape hatch. Single-process only — the
+        centers (subword grouping overrides this to False), the corpus
+        fits the HBM budget reserved for it (GLINT_DEVICE_CORPUS_MAX_BYTES
+        overrides the 2 GiB default; tables need the rest), and no env
+        escape hatch. Frequency subsampling no longer disqualifies —
+        the per-epoch compaction pass runs on device
+        (ops/device_batching.subsample_compact) — but it triples the HBM
+        charge: the flat corpus plus the compacted buffer plus the
+        transient prefix sums hold ~12 bytes/word replicated per device,
+        vs ~4 bytes/word without subsampling. Single-process only — the
         caller checks process count."""
         raw_budget = os.environ.get("GLINT_DEVICE_CORPUS_MAX_BYTES")
         try:
@@ -331,9 +333,9 @@ class Word2Vec:
                 "using the 2 GiB default", raw_budget,
             )
             budget = 2 << 30
+        bytes_per_word = 12 if self.params.subsample_ratio > 0 else 4
         return (
-            self.params.subsample_ratio == 0.0
-            and 4 * corpus_words <= budget
+            bytes_per_word * corpus_words <= budget
             # upload_corpus indexes the flat corpus with int32; an
             # oversized corpus routes to the host batcher, not an error.
             and corpus_words < 2**31
@@ -354,19 +356,29 @@ class Word2Vec:
         .upload_corpus) and every minibatch is assembled inside the
         jitted scan (ops/device_batching) — per-dispatch host->device
         traffic is scalars, and the host thread's only jobs are the LR
-        schedule and metrics. Batch-for-batch it consumes the same
-        center-position stream as the host pipeline (subsample=0), so
-        quality gates and LR accounting match; the window-shrink RNG
-        stream differs (device threefry), like the native C++ pass
-        already differs from the Python fallback."""
+        schedule and metrics. With ``subsample_ratio > 0`` a per-epoch
+        jitted pass subsample-compacts the corpus on device
+        (EmbeddingEngine.compact_corpus); the host reads back one scalar
+        (``n_kept``) plus the compacted sentence offsets per epoch to
+        size the step loop and keep the pre-subsampling words_done
+        accounting. Batch-for-batch the un-subsampled stream matches the
+        host pipeline's packing, so quality gates and LR accounting
+        match; the subsample/window-shrink RNG streams differ (device
+        threefry), like the native C++ pass already differs from the
+        Python fallback."""
         import jax
 
         p = self.params
+        subsampling = p.subsample_ratio > 0
         logger.info(
-            "vocab: %d words, %d train words (device-resident corpus)",
+            "vocab: %d words, %d train words (device-resident corpus%s)",
             vocab.size, vocab.train_words_count,
+            ", on-device subsampling" if subsampling else "",
         )
-        from glint_word2vec_tpu.ops.device_batching import corpus_words_done
+        from glint_word2vec_tpu.ops.device_batching import (
+            corpus_words_done,
+            corpus_words_done_compacted,
+        )
 
         mesh = self._make_mesh()
         if p.batch_size % mesh.shape["data"]:
@@ -376,10 +388,12 @@ class Word2Vec:
             )
         engine = self._make_engine(mesh, vocab)
         engine.upload_corpus(ids, offsets)
+        if subsampling:
+            engine.set_keep_probs(
+                vocab.device_keep_probabilities(p.subsample_ratio)
+            )
         N = int(ids.shape[0])
         B, spc = p.batch_size, p.steps_per_call
-        steps_per_epoch = max(1, -(-N // B))
-        groups = max(1, -(-steps_per_epoch // spc))
         twc = vocab.train_words_count
         total_words = p.num_iterations * twc + 1
         base_key = jax.random.PRNGKey(p.seed)
@@ -403,24 +417,47 @@ class Word2Vec:
         metrics = TrainingMetrics(base_words=start_epoch * twc)
 
         for epoch in range(start_epoch, p.num_iterations):
+            if subsampling:
+                # The epoch's subsample draws are keyed by epoch alone
+                # (the reference reseeds per iteration, mllib:371-373),
+                # so a resumed run recompacts epoch e to the identical
+                # buffers — no compaction state needs checkpointing.
+                with metrics.timing("step"):
+                    n_pos = engine.compact_corpus(
+                        jax.random.fold_in(base_key, epoch)
+                    )
+                offsets_c = engine.compacted_offsets()
+            else:
+                n_pos, offsets_c = N, None
+            steps_per_epoch = max(1, -(-n_pos // B))
+            groups = max(1, -(-steps_per_epoch // spc))
             for g in range(groups):
                 start_pos = g * spc * B
                 with metrics.timing("host"):
                     # LR anneal: the host batcher's pre-subsampling
-                    # words_done accounting, computed from offsets alone.
+                    # words_done accounting — from the original offsets
+                    # alone, or looked up through the epoch's compacted
+                    # offsets when subsampling.
                     alphas = np.empty(spc, np.float32)
                     wds = np.empty(spc, np.int64)
                     for j in range(spc):
-                        end_pos = min(start_pos + (j + 1) * B, N)
-                        wd = epoch * twc + corpus_words_done(
-                            offsets, end_pos
-                        )
+                        end_pos = min(start_pos + (j + 1) * B, n_pos)
+                        if subsampling:
+                            done = corpus_words_done_compacted(
+                                offsets, offsets_c, end_pos, n_pos
+                            )
+                        else:
+                            done = corpus_words_done(offsets, end_pos)
+                        wd = epoch * twc + done
                         wds[j] = wd
                         alphas[j] = max(
                             p.step_size * (1 - wd / total_words),
                             p.step_size * 1e-4,
                         )
-                n_real = min(spc, max(0, -(-(N - start_pos) // B)))
+                # An epoch subsampled to nothing dispatches its one
+                # no-op group but records no steps — the host batcher
+                # likewise yields no batches then.
+                n_real = min(spc, max(0, -(-(n_pos - start_pos) // B)))
                 with metrics.timing("step"):
                     losses = engine.train_steps_corpus(
                         start_pos, B, p.window, base_key, alphas, step

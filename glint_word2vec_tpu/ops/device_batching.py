@@ -22,9 +22,18 @@ shrink RNG stream (device threefry vs host PCG64; the host native/C++
 pass already diverges from Python the same way).
 
 Frequency subsampling *compacts* sentences before windowing (it changes
-neighbor distances), which is a data-dependent reshape the static-shape
-scan cannot express cheaply; callers with ``subsample_ratio > 0`` keep
-the host pipeline (models/word2vec.py routes accordingly).
+neighbor distances, exactly the reference's per-iteration pass,
+mllib:371-390). That compaction now ALSO runs on device: once per epoch
+:func:`subsample_compact` draws the keep mask (Bernoulli with
+``keep_prob[ids[t]]``, keyed ``fold_in(epoch_key, position)`` so the
+draws are mesh-invariant by construction), prefix-sums it, and
+scatter-compacts ``(ids, offsets)`` into same-shape device buffers
+``(ids_c, offsets_c)`` plus a traced element count ``n_kept``.
+:func:`device_window_batch` then runs unchanged over the compacted
+arrays with ``n_kept`` as its (traced) corpus-end bound — so
+``subsample_ratio > 0`` keeps the scalars-only dispatch path instead of
+falling back to the host batcher (models/word2vec.py routes the device
+path for both settings).
 """
 
 from __future__ import annotations
@@ -38,6 +47,12 @@ from glint_word2vec_tpu.corpus.batching import window_offsets
 #: Domain-separation constant for the window-shrink draws ("wind").
 WINDOW_FOLD = 0x77696E64
 
+#: Domain-separation constant for the per-epoch subsample draws ("subs"):
+#: folded into the epoch key before the per-position fold, so the
+#: subsample stream can never collide with the window/negative streams
+#: (those fold WINDOW_FOLD or a global row index < 2**30 instead).
+SUBSAMPLE_FOLD = 0x73756273
+
 
 def device_window_batch(
     ids: jax.Array,  # (N,) int32 flat corpus
@@ -46,6 +61,7 @@ def device_window_batch(
     rows: jax.Array,  # (B,) int32 GLOBAL batch-row indices (key the draws)
     key: jax.Array,
     window: int,
+    n_valid=None,  # traced int32 scalar corpus-end bound (None = ids.shape[0])
 ):
     """Assemble one (centers, contexts, mask) minibatch on device.
 
@@ -56,15 +72,24 @@ def device_window_batch(
     so a data rank holding global rows [r0, r0+Bl) draws exactly what a
     single-rank run draws for those rows while doing only O(local rows)
     work (no global-batch over-draw).
+
+    ``n_valid`` is the corpus-end bound as a *traced* scalar — the
+    subsampled path passes the epoch's ``n_kept`` (compacted buffers
+    keep the static shape N, only a prefix is live). None (the
+    un-subsampled path) means the full static extent. Context validity
+    needs no extra bound: compacted sentence offsets never exceed
+    ``n_kept``, so the sentence-end check already excludes the dead tail.
     """
     N = ids.shape[0]
     W = int(window)
+    if n_valid is None:
+        n_valid = N
 
     # Both bounds: upload_corpus permits N up to 2**31-1, so a tail
     # group's positions can overflow int32 and wrap negative — without
     # the >= 0 check a wrapped position would clip to 0 and train real
     # updates on sentence-0 windows instead of masking out.
-    in_corpus = (positions >= 0) & (positions < N)
+    in_corpus = (positions >= 0) & (positions < n_valid)
     p = jnp.clip(positions, 0, max(N - 1, 0))
     sent = jnp.searchsorted(offsets, p, side="right") - 1
     start = offsets[sent]
@@ -89,6 +114,65 @@ def device_window_batch(
     return centers, contexts.astype(jnp.int32), valid.astype(jnp.float32)
 
 
+def subsample_keep_mask(
+    ids: jax.Array, keep_prob: jax.Array, epoch_key: jax.Array
+) -> jax.Array:
+    """Per-position Bernoulli keep mask for frequency subsampling.
+
+    Position ``t`` is kept iff ``u_t <= keep_prob[ids[t]]`` with
+    ``u_t ~ U[0, 1)`` — the host rule (corpus/batching.subsample_sentence)
+    on a device RNG stream. Each draw is keyed
+    ``fold_in(fold_in(epoch_key, SUBSAMPLE_FOLD), t)``: purely elementwise
+    in the position, so the values cannot depend on how GSPMD partitions
+    the computation — mesh-invariant by construction (a bulk
+    ``uniform(key, (N,))`` draw is NOT under the legacy non-partitionable
+    threefry lowering).
+    """
+    N = ids.shape[0]
+    base = jax.random.fold_in(epoch_key, SUBSAMPLE_FOLD)
+    u = jax.vmap(
+        lambda t: jax.random.uniform(jax.random.fold_in(base, t), ())
+    )(jnp.arange(N, dtype=jnp.uint32))
+    return u <= keep_prob[ids]
+
+
+def subsample_compact(
+    ids: jax.Array,  # (N,) int32 flat corpus
+    offsets: jax.Array,  # (S+1,) int32 sentence offsets
+    keep_prob: jax.Array,  # (V,) float32 per-word keep probability
+    epoch_key: jax.Array,
+):
+    """One epoch's subsample-and-compact pass, entirely on device.
+
+    Returns ``(ids_c, offsets_c, n_kept)``: the kept tokens compacted to
+    the front of a same-shape (N,) buffer (tail zeros are dead — bounded
+    off by ``n_kept`` and the compacted sentence offsets), the per-
+    sentence offsets remapped into compacted coordinates (a sentence
+    subsampled to nothing becomes an empty span, which ``searchsorted``
+    in :func:`device_window_batch` skips naturally), and the traced kept
+    count. Compaction happens BEFORE windowing — the reference's
+    semantics (mllib:371-390): dropping a word shortens the distances
+    between its surviving neighbors.
+
+    The pass is integer-exact (elementwise draws, int32 prefix sum,
+    deterministic scatter), so its output is bitwise identical on every
+    mesh shape. HBM cost: one extra int32 buffer per corpus word plus
+    the transient prefix sums (~12 bytes/word peak together with the
+    flat corpus; models/word2vec._device_corpus_eligible budgets this).
+    """
+    N = ids.shape[0]
+    keep = subsample_keep_mask(ids, keep_prob, epoch_key)
+    k32 = keep.astype(jnp.int32)
+    incl = jnp.cumsum(k32)  # inclusive prefix: kept in [0, t]
+    n_kept = incl[-1] if N else jnp.int32(0)
+    dest = incl - k32  # exclusive prefix = compacted destination
+    scatter_idx = jnp.where(keep, dest, N)  # dropped tokens land out of range
+    ids_c = jnp.zeros(N, jnp.int32).at[scatter_idx].set(ids, mode="drop")
+    kept_before = jnp.concatenate([jnp.zeros(1, jnp.int32), incl])
+    offsets_c = kept_before[offsets].astype(jnp.int32)
+    return ids_c, offsets_c, n_kept
+
+
 def corpus_words_done(offsets: np.ndarray, end_position: int) -> int:
     """Host-side words_done after consuming center positions [0, end).
 
@@ -101,4 +185,30 @@ def corpus_words_done(offsets: np.ndarray, end_position: int) -> int:
         return 0
     end_position = min(int(end_position), int(offsets[-1]))
     j = int(np.searchsorted(offsets, end_position - 1, side="right")) - 1
+    return int(offsets[j + 1])
+
+
+def corpus_words_done_compacted(
+    offsets: np.ndarray,  # (S+1,) ORIGINAL sentence offsets
+    offsets_c: np.ndarray,  # (S+1,) compacted sentence offsets
+    end_position: int,  # consumed compacted center positions [0, end)
+    n_kept: int,
+) -> int:
+    """Host-side words_done over the epoch's COMPACTED position stream.
+
+    Same convention as :func:`corpus_words_done` — a sentence's FULL
+    pre-subsampling word count is credited as soon as any of its kept
+    positions is consumed (the host batcher counts pre-subsampling words
+    so the LR anneal never stalls; corpus/batching.SkipGramBatcher) —
+    looked up through the compacted offsets. Consuming the whole
+    compacted stream credits the whole corpus: the host batcher consumes
+    every sentence by then, including ones subsampling emptied.
+    """
+    if end_position >= n_kept:
+        return int(offsets[-1])
+    if end_position <= 0:
+        return 0
+    # side="right" over possibly-repeated compacted offsets: lands past
+    # every emptied sentence preceding the one owning position end-1.
+    j = int(np.searchsorted(offsets_c, end_position - 1, side="right")) - 1
     return int(offsets[j + 1])

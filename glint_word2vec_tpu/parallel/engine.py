@@ -314,6 +314,14 @@ class EmbeddingEngine:
         # Randoms are drawn for the unpadded rows/cols only, then
         # zero-padded, so initial values are layout- and mesh-shape-
         # invariant (a "dims" engine starts bitwise-equal to a "rows" one).
+        # The init MUST trace with partitionable threefry: the legacy
+        # (non-partitionable) lowering produces sharding-DEPENDENT random
+        # values when GSPMD partitions the draw — on meshes with data > 1
+        # and certain model-axis sizes the tables came up different from
+        # every other mesh shape, breaking the seed -> identical-tables
+        # contract (the two round-0 mesh-invariance test failures). Scoped
+        # to this one jit so every other RNG stream (negatives, window
+        # shrink) keeps its existing draws.
         tsh = self._table_sharding()
         V, Vp, d, dp = self.num_rows, self.padded_vocab, self.dim, self.padded_dim
 
@@ -322,9 +330,16 @@ class EmbeddingEngine:
             pad = ((0, Vp - V), (0, dp - d))
             return jnp.pad(s0, pad), jnp.pad(s1, pad)
 
-        self.syn0, self.syn1 = jax.jit(_init, out_shardings=(tsh, tsh))(
-            jax.random.PRNGKey(seed)
-        )
+        prev_partitionable = jax.config.jax_threefry_partitionable
+        jax.config.update("jax_threefry_partitionable", True)
+        try:
+            self.syn0, self.syn1 = jax.jit(_init, out_shardings=(tsh, tsh))(
+                jax.random.PRNGKey(seed)
+            )
+        finally:
+            jax.config.update(
+                "jax_threefry_partitionable", prev_partitionable
+            )
         self._build_jitted_fns()
 
     def _table_sharding(self):
@@ -660,6 +675,9 @@ class EmbeddingEngine:
             # only its Bl = B/num_data rows. Keys follow the exact
             # fold_in(base_key, step0 + i) schedule of local_train_scan,
             # so negatives match a host-batched run step for step.
+            # ``n_valid`` (the corpus-end bound) is a TRACED scalar so
+            # the subsampled path's per-epoch n_kept shares this one
+            # compile with the full-corpus path.
             from glint_word2vec_tpu.ops.device_batching import (
                 device_window_batch,
             )
@@ -667,7 +685,8 @@ class EmbeddingEngine:
             Bl = B // num_data
 
             def local_corpus_scan(syn0_l, syn1_l, prob, alias, ids, soffs,
-                                  pstart, base_key, step0, alphas_k):
+                                  n_valid, pstart, base_key, step0,
+                                  alphas_k):
                 drank = lax.axis_index(DATA_AXIS)
                 rows_l = (drank * Bl + jnp.arange(Bl)).astype(jnp.int32)
 
@@ -679,7 +698,8 @@ class EmbeddingEngine:
                         pstart + jnp.int32(i) * jnp.int32(B) + rows_l
                     )
                     centers, contexts, mask = device_window_batch(
-                        ids, soffs, positions, rows_l, key, W
+                        ids, soffs, positions, rows_l, key, W,
+                        n_valid=n_valid,
                     )
                     cmask = jnp.ones((Bl, 1), jnp.float32)
                     s0, s1, loss = step_body(
@@ -700,7 +720,7 @@ class EmbeddingEngine:
                 self._shard_map(
                     local_corpus_scan,
                     in_specs=(tspec, tspec, rep, rep, rep, rep,
-                              rep, rep, rep, rep),
+                              rep, rep, rep, rep, rep),
                     out_specs=(tspec, tspec, rep),
                 ),
                 donate_argnums=(0, 1),
@@ -1056,8 +1076,9 @@ class EmbeddingEngine:
         ``(ids, offsets)``) to device HBM once. Subsequent
         :meth:`train_steps_corpus` dispatches assemble minibatches
         entirely on device (ops/device_batching) — per-dispatch
-        host->device traffic drops to scalars. ~4 bytes/word of HBM,
-        replicated per device."""
+        host->device traffic drops to scalars. ~4 bytes/word of HBM
+        replicated per device (~12 with the subsampled path's compacted
+        buffers, see :meth:`compact_corpus`)."""
         n = int(np.asarray(ids).shape[0])
         if n < 1 or n >= 2**31 or int(np.asarray(offsets)[-1]) != n:
             raise ValueError(
@@ -1068,6 +1089,8 @@ class EmbeddingEngine:
             jnp.asarray(ids, dtype=jnp.int32),
             jnp.asarray(offsets, dtype=jnp.int32),
         )
+        self._corpus_compacted = None
+        self._n_kept = None
 
     @property
     def corpus_positions(self) -> int:
@@ -1076,15 +1099,79 @@ class EmbeddingEngine:
             raise ValueError("no corpus uploaded (call upload_corpus first)")
         return int(self._corpus[0].shape[0])
 
+    def set_keep_probs(self, keep_prob: np.ndarray) -> None:
+        """Install the per-word keep-probability table driving on-device
+        frequency subsampling (Vocabulary.device_keep_probabilities).
+        Required before :meth:`compact_corpus`."""
+        kp = np.asarray(keep_prob, dtype=np.float32)
+        if kp.shape != (self.vocab_size,):
+            raise ValueError(
+                f"keep_prob must have shape ({self.vocab_size},), "
+                f"got {kp.shape}"
+            )
+        self._keep_prob = jnp.asarray(kp)
+
+    def compact_corpus(self, epoch_key) -> int:
+        """Run one epoch's on-device subsample-and-compact pass
+        (ops/device_batching.subsample_compact) over the uploaded corpus
+        and make the compacted view the active corpus for subsequent
+        :meth:`train_steps_corpus` dispatches. Returns ``n_kept`` — the
+        single scalar the host reads back per epoch to size its step
+        loop. The previous epoch's compacted buffers are freed first so
+        HBM holds at most one compacted copy alongside the flat corpus.
+        """
+        if getattr(self, "_corpus", None) is None:
+            raise ValueError("no corpus uploaded (call upload_corpus first)")
+        if getattr(self, "_keep_prob", None) is None:
+            raise ValueError(
+                "no keep probabilities installed (call set_keep_probs first)"
+            )
+        old = self._corpus_compacted
+        self._corpus_compacted = None
+        self._compacted_offsets_host = None
+        if old is not None:
+            for a in old:
+                try:
+                    a.delete()
+                except Exception:
+                    pass
+        if not hasattr(self, "_compact_fn"):
+            from glint_word2vec_tpu.ops.device_batching import (
+                subsample_compact,
+            )
+
+            self._compact_fn = jax.jit(subsample_compact)
+        ids, offsets = self._corpus
+        ids_c, offsets_c, n_kept = self._compact_fn(
+            ids, offsets, self._keep_prob, epoch_key
+        )
+        self._corpus_compacted = (ids_c, offsets_c)
+        self._n_kept = int(n_kept)
+        return self._n_kept
+
+    def compacted_offsets(self) -> np.ndarray:
+        """Host copy of the active epoch's compacted sentence offsets —
+        one (S+1,) readback per epoch, feeding the pre-subsampling
+        words_done accounting (corpus_words_done_compacted)."""
+        if getattr(self, "_corpus_compacted", None) is None:
+            raise ValueError("no compacted corpus (call compact_corpus)")
+        if getattr(self, "_compacted_offsets_host", None) is None:
+            self._compacted_offsets_host = np.asarray(
+                self._corpus_compacted[1]
+            )
+        return self._compacted_offsets_host
+
     def train_steps_corpus(
         self, start_position: int, batch_size: int, window: int,
         base_key, alphas, step0: int = 0
     ) -> jax.Array:
-        """K = len(alphas) scanned minibatches over the uploaded corpus,
-        starting at flat center position ``start_position``. Batch i
-        covers positions [start + i*B, start + (i+1)*B); positions past
-        the corpus end become zero-mask rows (the epoch tail). Returns
-        the (K,) per-step losses. Key schedule matches
+        """K = len(alphas) scanned minibatches over the ACTIVE corpus
+        view — the epoch's compacted buffers when :meth:`compact_corpus`
+        has run (subsampled training; ``start_position`` is then a
+        compacted-stream position), else the full uploaded corpus.
+        Batch i covers positions [start + i*B, start + (i+1)*B);
+        positions past the corpus end become zero-mask rows (the epoch
+        tail). Returns the (K,) per-step losses. Key schedule matches
         :meth:`train_steps` exactly."""
         if getattr(self, "_corpus", None) is None:
             raise ValueError("no corpus uploaded (call upload_corpus first)")
@@ -1098,11 +1185,16 @@ class EmbeddingEngine:
             fn = self._corpus_scan_cache[(B, W)] = self._make_corpus_scan(
                 B, W
             )
-        ids, soffs = self._corpus
+        if getattr(self, "_corpus_compacted", None) is not None:
+            ids, soffs = self._corpus_compacted
+            n_valid = self._n_kept
+        else:
+            ids, soffs = self._corpus
+            n_valid = ids.shape[0]
         self.syn0, self.syn1, losses = fn(
             self.syn0, self.syn1, self._prob, self._alias, ids, soffs,
-            jnp.int32(start_position), base_key, jnp.uint32(step0),
-            jnp.asarray(alphas, dtype=jnp.float32),
+            jnp.int32(n_valid), jnp.int32(start_position), base_key,
+            jnp.uint32(step0), jnp.asarray(alphas, dtype=jnp.float32),
         )
         self._norms_cache = None
         return losses
@@ -1453,13 +1545,21 @@ class EmbeddingEngine:
     def destroy(self) -> None:
         """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
         corpus = getattr(self, "_corpus", None) or ()
-        for a in (self.syn0, self.syn1, self._prob, self._alias, *corpus):
+        compacted = getattr(self, "_corpus_compacted", None) or ()
+        keep_prob = getattr(self, "_keep_prob", None)
+        extras = (keep_prob,) if keep_prob is not None else ()
+        for a in (
+            self.syn0, self.syn1, self._prob, self._alias,
+            *corpus, *compacted, *extras,
+        ):
             try:
                 a.delete()
             except Exception:
                 pass
         self.syn0 = self.syn1 = self._prob = self._alias = None
         self._corpus = None
+        self._corpus_compacted = None
+        self._keep_prob = None
         self._norms_cache = None
 
     @property

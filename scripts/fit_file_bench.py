@@ -64,6 +64,10 @@ def main():
     B = int(os.environ.get("FITBENCH_BATCH", 8192))
     spc = int(os.environ.get("FITBENCH_SPC", 32))
     dtype = os.environ.get("FITBENCH_DTYPE", "bfloat16")
+    # FITBENCH_SUBSAMPLE > 0 exercises the realistic production config:
+    # frequency subsampling stays on the device-resident path via the
+    # per-epoch on-device compaction pass (ops/device_batching).
+    subsample = float(os.environ.get("FITBENCH_SUBSAMPLE", 0.0))
     corpus = os.environ.get(
         "FITBENCH_CORPUS", f"/tmp/fitbench_{V}_{total}.txt"
     )
@@ -81,7 +85,7 @@ def main():
         mesh=make_mesh(1, 1, devices=[dev]),
         vector_size=int(os.environ.get("FITBENCH_DIM", 300)),
         batch_size=B, min_count=1, num_iterations=1, seed=1,
-        steps_per_call=spc, dtype=dtype,
+        steps_per_call=spc, dtype=dtype, subsample_ratio=subsample,
         compute_dtype=os.environ.get("FITBENCH_COMPUTE", "bfloat16"),
         shared_negatives=int(os.environ.get("FITBENCH_SHARED", 0)),
     ).fit_file(corpus)
@@ -99,6 +103,11 @@ def main():
         "batch": B,
         "steps_per_call": spc,
         "table_dtype": dtype,
+        # Effective subsample ratio (0 = off) and which pipeline the fit
+        # actually routed to — the whole point of the subsampled config
+        # is staying on the device_corpus pipeline.
+        "subsample_ratio": subsample,
+        "pipeline": tm.get("pipeline"),
         "vocab_built": model.vocab.size,
         "corpus_gen_seconds": round(gen_s, 1),
         "fit_wall_seconds": round(fit_s, 1),

@@ -34,6 +34,45 @@ def model(tiny_corpus):
     m.stop()
 
 
+@pytest.fixture(scope="module")
+def model_subsampled(tiny_corpus):
+    # The production config: frequency subsampling ON, trained on the
+    # device-resident corpus path (per-epoch on-device compaction). The
+    # ratio is chosen so subsampling actually bites on the tiny corpus's
+    # frequent relation words ("the", "capital", ...) the way 1e-3..1e-5
+    # bites on a real corpus.
+    w2v = (
+        Word2Vec(mesh=make_mesh(2, 4))
+        .set_vector_size(48)
+        .set_window_size(5)
+        .set_step_size(0.025)
+        .set_batch_size(256)
+        .set_num_negatives(5)
+        .set_min_count(5)
+        .set_num_iterations(6)
+        .set_subsample_ratio(0.03)
+        .set_seed(1)
+    )
+    m = w2v.fit(tiny_corpus)
+    yield m
+    m.stop()
+
+
+def test_subsampled_device_path_passes_quality_gates(model_subsampled):
+    # Same thresholds as the un-subsampled gates below: subsampling on
+    # the device path must still learn the capital/country structure.
+    m = model_subsampled
+    assert m.training_metrics["pipeline"] == "device_corpus"
+    syns = m.find_synonyms("austria", 10)
+    words = [w for w, _ in syns]
+    assert "vienna" in words, f"vienna not in {words}"
+    assert dict(syns)["vienna"] > 0.5, syns
+    res = m.analogy(
+        positive=["vienna", "germany"], negative=["austria"], num=10
+    )
+    assert "berlin" in [w for w, _ in res], res
+
+
 def test_capital_synonym_gate(model):
     # Reference gate: wien in top-10 synonyms of österreich with cos > 0.9
     # (Spec.scala:297-302). Synthetic-corpus analogue with the same
