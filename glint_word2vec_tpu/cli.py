@@ -97,6 +97,16 @@ def _add_query(sub):
     p.add_argument("--model", required=True)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8801)
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="coalesced /synonyms dispatch cap (rounded up to "
+                        "a power of two; Q shape buckets warm up to it)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-binding compilation of the serving "
+                        "shape family (first requests then pay jit "
+                        "compiles)")
+    p.add_argument("--cache-size", type=int, default=65536,
+                   help="synonym result-cache entries (0 disables); "
+                        "invalidated wholesale on any table mutation")
 
     p = sub.add_parser(
         "eval", help="analogy accuracy on a standard question file"
@@ -177,7 +187,11 @@ def _run(args) -> int:
     if args.cmd == "serve":
         from glint_word2vec_tpu.serving import serve_model_dir
 
-        serve_model_dir(args.model, host=args.host, port=args.port)
+        serve_model_dir(
+            args.model, host=args.host, port=args.port,
+            max_batch=args.max_batch, warmup=not args.no_warmup,
+            cache_size=args.cache_size,
+        )
         return 0
 
     model = load_model(args.model)

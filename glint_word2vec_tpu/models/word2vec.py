@@ -35,6 +35,7 @@ from glint_word2vec_tpu.corpus.batching import (
     encode_sentences,
 )
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.utils import next_pow2
 from glint_word2vec_tpu.utils.metrics import TrainingMetrics
 from glint_word2vec_tpu.utils.params import Word2VecParams
 from glint_word2vec_tpu.utils.prefetch import prefetch
@@ -871,14 +872,19 @@ class Word2VecModel:
             L = max((len(x) for x in block), default=0)
             if L == 0:
                 continue
-            idx = np.zeros((len(block), L), np.int32)
-            m = np.zeros((len(block), L), np.float32)
+            # Rows and max-length pad to power-of-two buckets so repeated
+            # serving calls with jittering shapes hit a small compiled
+            # family instead of one jit per (S, L). Padding is mask-0:
+            # padded rows come back as the zero vector (sliced off) and
+            # padded columns add exact +0.0 terms to each masked mean.
+            idx = np.zeros((next_pow2(len(block)), next_pow2(L)), np.int32)
+            m = np.zeros(idx.shape, np.float32)
             for i, x in enumerate(block):
                 idx[i, : len(x)] = x
                 m[i, : len(x)] = 1.0
             out[s : s + len(block)] = np.asarray(
                 self.engine.pull_average(idx, m)
-            )
+            )[: len(block)]
         return out
 
     # ------------------------------------------------------------------

@@ -60,6 +60,7 @@ except ImportError:  # pragma: no cover
 
 from glint_word2vec_tpu.corpus.alias import build_unigram_alias
 from glint_word2vec_tpu.ops import sgns
+from glint_word2vec_tpu.utils import next_pow2
 from glint_word2vec_tpu.ops.sampling import (
     sample_negatives,
     sample_negatives_per_row,
@@ -129,6 +130,22 @@ def _scatter_rows(table_l, idx, upd, start, rows_per_shard, pallas_mode=0):
 #: VMEM budget for pinning h_g whole in the fused rank-1 scatter kernel
 #: (ops/pallas_rows.scatter_add_rank1): ~16 MB/core minus block buffers.
 _RANK1_FUSE_VMEM_BYTES = 10_000_000
+
+#: Floor of the top-k k-bucket family. Requested k is rounded up to
+#: ``max(next_pow2(k), TOPK_MIN_K_BUCKET)`` (capped at padded_vocab) and
+#: the result truncated to k, so every small-k request — num defaults,
+#: analogy exclusion fudge, coalesced maxima — lands on ONE compiled
+#: program instead of one per distinct k. Top-16 vs top-2 on device is
+#: free; a serving-path recompile is seconds of tail latency.
+TOPK_MIN_K_BUCKET = 16
+
+#: Floor of the batched top-k Q-bucket family for Q > 1. Batches of
+#: 2..7 queries pad to 8 rows: skinny (Q=2..4)-row gemms fall off the
+#: fast blocked path on some backends (XLA CPU runs them ~6x SLOWER
+#: than the same scoring at Q=8), and matmul units pad small batches
+#: internally anyway. Q=1 keeps its own bucket — the dominant
+#: low-concurrency shape, served by the bandwidth-bound matvec.
+TOPK_MIN_Q_BUCKET = 8
 
 
 def _rank1_payload(cpos_g, cneg_g, C: int, n: int):
@@ -814,6 +831,24 @@ class EmbeddingEngine:
 
         norms_spec = rep if dims else P(MODEL_AXIS)
 
+        def _mask_terms(norms_l, start):
+            # Cosine masking as one multiply + one add instead of a
+            # division plus two (.., V)-wide boolean selects: inv is the
+            # reciprocal norm (0 on masked rows), neg pins masked rows
+            # at -inf. Zero-norm rows must never outrank a real word
+            # with negative cosine (the reference's zero-norm guard at
+            # mllib:603-609 only had to avoid a 0/0); likewise rows past
+            # vocab_size (padding / subword buckets): only real words
+            # may surface from similarity search. Both vectors are (V,)
+            # so the per-score work is a fused multiply-add — on the
+            # serving path this cut batch top-k time ~30% (SERVING_BENCH).
+            ok = (norms_l > 0) & (
+                start + jnp.arange(norms_l.shape[0]) < self.vocab_size
+            )
+            inv = jnp.where(ok, 1.0 / jnp.where(norms_l > 0, norms_l, 1.0), 0.0)
+            neg = jnp.where(ok, 0.0, -jnp.inf)
+            return inv, neg
+
         def make_topk(k: int):
             def local_topk(table_l, v, norms_l):
                 if dims:
@@ -824,14 +859,10 @@ class EmbeddingEngine:
                         table_l.astype(jnp.float32) @ _local_cols(v),
                         MODEL_AXIS,
                     )  # (V,)
-                    safe = jnp.where(norms_l > 0, norms_l, 1.0)
-                    is_word = (
-                        jnp.arange(scores.shape[0]) < self.vocab_size
+                    inv, neg = _mask_terms(norms_l, 0)
+                    val, idx = lax.top_k(
+                        scores * inv + neg, min(k, scores.shape[0])
                     )
-                    cos = jnp.where(
-                        (norms_l > 0) & is_word, scores / safe, -jnp.inf
-                    )
-                    val, idx = lax.top_k(cos, min(k, scores.shape[0]))
                     return val, idx
                 # Cosine top-k without materializing all V scores on one
                 # device: local top-k per shard, all_gather the M*k
@@ -840,17 +871,8 @@ class EmbeddingEngine:
                 start = lax.axis_index(MODEL_AXIS) * Vs
                 kk = min(k, Vs)
                 scores = table_l.astype(jnp.float32) @ v
-                # Zero-norm rows must never outrank a real word with
-                # negative cosine: score them -inf (the reference's
-                # zero-norm guard at mllib:603-609 only had to avoid a 0/0).
-                # Likewise rows past vocab_size (padding / subword buckets):
-                # only real words may surface from similarity search.
-                safe = jnp.where(norms_l > 0, norms_l, 1.0)
-                is_word = (start + jnp.arange(Vs)) < self.vocab_size
-                cos = jnp.where(
-                    (norms_l > 0) & is_word, scores / safe, -jnp.inf
-                )
-                val, idx = lax.top_k(cos, kk)
+                inv, neg = _mask_terms(norms_l, start)
+                val, idx = lax.top_k(scores * inv + neg, kk)
                 cand_val = lax.all_gather(val, MODEL_AXIS, tiled=True)
                 cand_idx = lax.all_gather(idx + start, MODEL_AXIS, tiled=True)
                 mval, mpos = lax.top_k(cand_val, min(k, cand_val.shape[0]))
@@ -866,6 +888,10 @@ class EmbeddingEngine:
 
         def make_topk_batch(k: int):
             def local_topk_batch(table_l, q, norms_l):
+                # Scores are computed as (table @ q.T).T, not q @ table.T:
+                # the tall-skinny orientation streams the row-major table
+                # once (bandwidth-bound like the single-query matvec) —
+                # 2x faster for small Q buckets on CPU, a wash at Q=16+.
                 if dims:
                     # q arrives padded to (Q, padded_dim); each shard
                     # scores its column block, psum -> full scores. The
@@ -875,29 +901,24 @@ class EmbeddingEngine:
                         q, mrank * dcols, dcols, axis=1
                     )
                     scores = lax.psum(
-                        q_l @ table_l.astype(jnp.float32).T, MODEL_AXIS
+                        (table_l.astype(jnp.float32) @ q_l.T).T, MODEL_AXIS
                     )  # (Q, V)
-                    safe = jnp.where(norms_l > 0, norms_l, 1.0)
-                    is_word = (
-                        jnp.arange(scores.shape[1]) < self.vocab_size
+                    inv, neg = _mask_terms(norms_l, 0)
+                    val, idx = lax.top_k(
+                        scores * inv[None, :] + neg[None, :],
+                        min(k, scores.shape[1]),
                     )
-                    cos = jnp.where(
-                        (norms_l > 0) & is_word, scores / safe, -jnp.inf
-                    )
-                    val, idx = lax.top_k(cos, min(k, scores.shape[1]))
                     return val, idx
                 # q: (Q, d) replicated query batch. Same candidate-merge
                 # scheme as the single-vector kernel, vectorized over Q —
                 # one MXU matmul scores all queries against this shard.
                 start = lax.axis_index(MODEL_AXIS) * Vs
                 kk = min(k, Vs)
-                scores = q @ table_l.astype(jnp.float32).T  # (Q, Vs)
-                safe = jnp.where(norms_l > 0, norms_l, 1.0)
-                is_word = (start + jnp.arange(Vs)) < self.vocab_size
-                cos = jnp.where(
-                    (norms_l > 0) & is_word, scores / safe, -jnp.inf
-                )
-                val, idx = lax.top_k(cos, kk)  # (Q, kk)
+                scores = (table_l.astype(jnp.float32) @ q.T).T  # (Q, Vs)
+                inv, neg = _mask_terms(norms_l, start)
+                val, idx = lax.top_k(
+                    scores * inv[None, :] + neg[None, :], kk
+                )  # (Q, kk)
                 cand_val = lax.all_gather(
                     val, MODEL_AXIS, tiled=True, axis=1
                 )
@@ -921,10 +942,20 @@ class EmbeddingEngine:
         self._topk_batch_cache: dict = {}
         self._make_topk = make_topk
         self._make_topk_batch = make_topk_batch
+        # Query-shape compile accounting: every distinct (op, shape
+        # bucket) a query op dispatches is one XLA compile (jit
+        # specializes on shape). The serving layer pads its dispatches
+        # to power-of-two buckets, so post-warmup this set stops
+        # growing — the /metrics zero-compile contract (ISSUE 2).
+        self._query_shapes: set = set()
+        self.query_compiles: int = 0
         # Lazy norms cache, invalidated by any table mutation — the engine-
         # side analogue of the reference's cached ``wordVecNorms``
-        # (mllib:486).
+        # (mllib:486). ``table_version`` ticks on the same mutations so
+        # layers above (the serving result cache) can validate anything
+        # derived from table values without holding device buffers.
         self._norms_cache = None
+        self.table_version = 0
 
     # ------------------------------------------------------------------
     # Training
@@ -995,6 +1026,7 @@ class EmbeddingEngine:
             cg, gm, cx, mk, key, jnp.float32(alpha),
         )
         self._norms_cache = None
+        self.table_version += 1
         return loss
 
     def train_steps(
@@ -1065,6 +1097,7 @@ class EmbeddingEngine:
             jnp.asarray(alphas, dtype=jnp.float32),
         )
         self._norms_cache = None
+        self.table_version += 1
         return losses
 
     # ------------------------------------------------------------------
@@ -1197,25 +1230,48 @@ class EmbeddingEngine:
             jnp.uint32(step0), jnp.asarray(alphas, dtype=jnp.float32),
         )
         self._norms_cache = None
+        self.table_version += 1
         return losses
 
     # ------------------------------------------------------------------
     # Serving ops (the BigWord2VecMatrix query surface)
     # ------------------------------------------------------------------
 
+    def _count_query_shape(self, *key) -> None:
+        """Record one query-op dispatch shape; a first-seen shape is one
+        jit compile (jit specializes per shape). Callers hold the query
+        lock on the serving path; elsewhere races only over-count."""
+        if key not in self._query_shapes:
+            self._query_shapes.add(key)
+            self.query_compiles += 1
+
+    def _k_bucket(self, k: int) -> int:
+        """Round a top-k request up to its compile bucket (see
+        TOPK_MIN_K_BUCKET)."""
+        return min(max(next_pow2(k), TOPK_MIN_K_BUCKET), self.padded_vocab)
+
+    def _q_bucket(self, n: int) -> int:
+        """Round a batch top-k row count up to its compile bucket (see
+        TOPK_MIN_Q_BUCKET)."""
+        return 1 if n <= 1 else max(next_pow2(n), TOPK_MIN_Q_BUCKET)
+
     def pull(self, indices) -> jax.Array:
         """Gather syn0 rows by global index (Glint ``pull``, mllib:514)."""
-        return self._pull(self.syn0, jnp.asarray(indices, dtype=jnp.int32))
+        idx = jnp.asarray(indices, dtype=jnp.int32)
+        self._count_query_shape("pull", int(idx.shape[0]))
+        return self._pull(self.syn0, idx)
 
     def pull_average(self, sentence_indices, mask) -> jax.Array:
         """Mean of syn0 rows per padded index-set row (Glint ``pullAverage``,
         ml:453): sentence embedding computed device-side; only S*d floats
         ever leave the device. All-masked rows yield zero vectors (the
         reference's empty-sentence average)."""
+        idx = jnp.asarray(sentence_indices, dtype=jnp.int32)
+        self._count_query_shape(
+            "pull_average", int(idx.shape[0]), int(idx.shape[1])
+        )
         return self._pull_average(
-            self.syn0,
-            jnp.asarray(sentence_indices, dtype=jnp.int32),
-            jnp.asarray(mask, dtype=jnp.float32),
+            self.syn0, idx, jnp.asarray(mask, dtype=jnp.float32)
         )
 
     def write_rows(self, start_row: int, rows: jax.Array) -> None:
@@ -1239,6 +1295,7 @@ class EmbeddingEngine:
             self.syn0, rows, jnp.int32(start_row)
         )
         self._norms_cache = None
+        self.table_version += 1
 
     def norms(self) -> jax.Array:
         """Per-row Euclidean norms of syn0, computed shard-local (Glint
@@ -1279,12 +1336,17 @@ class EmbeddingEngine:
         nrm = float(np.linalg.norm(v))
         if nrm > 0:
             v = v / nrm
-        if k not in self._topk_cache:
-            self._topk_cache[k] = self._make_topk(k)
-        val, idx = self._topk_cache[k](
+        # One compiled program per k-BUCKET, not per k: fetch the
+        # bucket's top-k (a sorted superset) and truncate. Exact — the
+        # global top-k is the prefix of the global top-k_bucket.
+        k_b = self._k_bucket(k)
+        if k_b not in self._topk_cache:
+            self._topk_cache[k_b] = self._make_topk(k_b)
+        self._count_query_shape("topk", k_b)
+        val, idx = self._topk_cache[k_b](
             self.syn0, self._pad_query(v), self.norms()
         )
-        return np.asarray(val), np.asarray(idx)
+        return np.asarray(val)[:k], np.asarray(idx)[:k]
 
     def top_k_cosine_batch(
         self, vecs, k: int
@@ -1304,8 +1366,10 @@ class EmbeddingEngine:
         if q.shape[0] == 0:
             empty = np.zeros((0, kk))
             return empty.astype(np.float32), empty.astype(np.int64)
-        if k not in self._topk_batch_cache:
-            self._topk_batch_cache[k] = self._make_topk_batch(k)
+        k_b = self._k_bucket(k)
+        if k_b not in self._topk_batch_cache:
+            self._topk_batch_cache[k_b] = self._make_topk_batch(k_b)
+        fn = self._topk_batch_cache[k_b]
         # Dims layout materializes full (Q, V) scores per shard; chunk Q
         # to a ~256 MB score-matrix budget so the intermediate stays
         # bounded at any vocab size (10M rows -> 6-query chunks).
@@ -1315,12 +1379,59 @@ class EmbeddingEngine:
             chunk = q.shape[0]
         vals, idxs = [], []
         for s in range(0, q.shape[0], chunk):
-            val, idx = self._topk_batch_cache[k](
-                self.syn0, self._pad_query(q[s : s + chunk]), self.norms()
-            )
-            vals.append(np.asarray(val))
-            idxs.append(np.asarray(idx))
+            qc = q[s : s + chunk]
+            n = qc.shape[0]
+            # Pad Q up to its bucket (power of two, floored at
+            # TOPK_MIN_Q_BUCKET) so concurrency jitter (every distinct
+            # coalesced batch size) maps onto a small compiled family.
+            # Zero-vector padding rows score 0 for real words and are
+            # sliced off; they can never perturb a real row's top-k
+            # (each query row ranks independently).
+            q_b = self._q_bucket(n)
+            if q_b != n:
+                qc = np.concatenate(
+                    [qc, np.zeros((q_b - n, qc.shape[1]), np.float32)]
+                )
+            self._count_query_shape("topk_batch", q_b, k_b)
+            val, idx = fn(self.syn0, self._pad_query(qc), self.norms())
+            vals.append(np.asarray(val)[:n, :kk])
+            idxs.append(np.asarray(idx)[:n, :kk])
         return np.concatenate(vals), np.concatenate(idxs)
+
+    def warmup(
+        self,
+        q_buckets=(1, 2, 4, 8, 16, 32, 64),
+        k_buckets=(TOPK_MIN_K_BUCKET,),
+        *,
+        sentence_lens=(),
+        sentence_rows=(1,),
+    ) -> int:
+        """Compile the query-op shape family up front so no real request
+        ever pays a jit compile (the serving warmup entry point, ISSUE 2).
+
+        Exercises ``pull`` and ``top_k_cosine_batch`` for every Q bucket,
+        ``top_k_cosine`` for every k bucket, and — when ``sentence_lens``
+        is given — ``pull_average`` for the (rows, len) sentence grid.
+        Buckets are quantized exactly as the query ops quantize real
+        requests, so a warmed bucket can never re-compile. Returns the
+        number of shapes this call compiled (0 = already warm)."""
+        before = self.query_compiles
+        d = self.dim
+        ks = sorted({self._k_bucket(int(k)) for k in k_buckets})
+        for k in ks:
+            self.top_k_cosine(np.zeros(d, np.float32), k)
+        for q in sorted({next_pow2(int(q)) for q in q_buckets}):
+            self.pull(np.zeros(q, np.int32))
+        for q in sorted({self._q_bucket(int(q)) for q in q_buckets}):
+            zq = np.zeros((q, d), np.float32)
+            for k in ks:
+                self.top_k_cosine_batch(zq, k)
+        for s in sorted({next_pow2(int(s)) for s in sentence_rows}):
+            for L in sorted({next_pow2(int(L)) for L in sentence_lens}):
+                self.pull_average(
+                    np.zeros((s, L), np.int32), np.zeros((s, L), np.float32)
+                )
+        return self.query_compiles - before
 
     # ------------------------------------------------------------------
     # Persistence / lifecycle
@@ -1523,6 +1634,7 @@ class EmbeddingEngine:
                 ),
             )
         self._norms_cache = None
+        self.table_version += 1
 
     def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:
         """Install host table values (unpadded, all num_rows rows),
@@ -1541,6 +1653,7 @@ class EmbeddingEngine:
         self.syn0 = jax.device_put(jnp.asarray(full0, dtype=self._dtype), tsh)
         self.syn1 = jax.device_put(jnp.asarray(full1, dtype=self._dtype), tsh)
         self._norms_cache = None
+        self.table_version += 1
 
     def destroy(self) -> None:
         """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
@@ -1561,6 +1674,7 @@ class EmbeddingEngine:
         self._corpus_compacted = None
         self._keep_prob = None
         self._norms_cache = None
+        self.table_version += 1
 
     @property
     def cols(self) -> int:

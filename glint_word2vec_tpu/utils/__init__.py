@@ -1,1 +1,12 @@
 """Utilities: parameter validation, logging/metrics, checkpointing."""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1). The shape-bucket
+    quantizer for the serving hot path: padding device dispatches to
+    power-of-two sizes bounds the jit-compiled shape family to
+    log2(max) members instead of one compile per distinct request
+    shape (Shazeer et al. 1602.02215's fixed-shape batched-dispatch
+    argument restated for XLA)."""
+    n = int(n)
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()

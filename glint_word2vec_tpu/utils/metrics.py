@@ -10,12 +10,14 @@ loss, and an optional ``jax.profiler`` trace capture around a step range.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -97,8 +99,14 @@ class TrainingMetrics:
             # buffer so it is not pinned for the run's lifetime.
             try:
                 self.last_loss = float(self._last_loss_lazy)
-            except Exception:
-                pass
+            except Exception as e:
+                # Keep the last synced loss, but never silently: a stale
+                # last_loss with no trace hid real dispatch failures
+                # (ADVICE.md round 5).
+                logger.warning(
+                    "final lazy-loss sync failed (last_loss=%s may be "
+                    "stale): %s", self.last_loss, e,
+                )
             self._last_loss_lazy = None
         return {
             "steps": self.steps,
@@ -113,6 +121,129 @@ class TrainingMetrics:
     def dump(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump({"summary": self.summary(), "history": self.history}, f)
+
+
+class LatencyHistogram:
+    """Fixed log-spaced latency histogram: O(1) memory per endpoint,
+    quantiles by linear interpolation inside the winning bucket.
+
+    Bucket edges run 50µs .. ~20min with a sqrt(2) growth factor, so
+    every quantile estimate is within ~±20% of the true value — plenty
+    for the p50/p95/p99 serving dashboards this feeds (the reference has
+    no serving telemetry at all, SURVEY.md §5)."""
+
+    _EDGES = [5e-5 * (2 ** (i / 2.0)) for i in range(64)]
+
+    __slots__ = ("counts", "n", "total", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(self._EDGES) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.counts[bisect.bisect_right(self._EDGES, seconds)] += 1
+        self.n += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if c and acc >= target:
+                lo = self._EDGES[i - 1] if i > 0 else 0.0
+                hi = self._EDGES[i] if i < len(self._EDGES) else self.max
+                hi = min(max(hi, lo), self.max) if self.max else hi
+                return lo + (hi - lo) * ((target - (acc - c)) / c)
+        return self.max
+
+
+class ServingMetrics:
+    """Serving-path observability for ``serving.ModelServer``:
+    per-endpoint latency histograms (p50/p95/p99), request/error
+    counters, the coalesced-batch-size distribution, and the engine's
+    query-shape compile counters — surfaced on ``/healthz`` and the
+    ``/metrics`` endpoint. Thread-safe (the HTTP server is threaded)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._hist: Dict[str, LatencyHistogram] = {}
+        self._errors: Dict[str, int] = {}
+        self._batches: Dict[int, int] = {}
+        #: Engine query-shape compiles at the end of server warmup;
+        #: ``snapshot`` reports compiles past this as ``post_warmup``.
+        self.warmup_compiles = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    #: Cap on distinct tracked endpoint paths: the key is the raw
+    #: client-supplied request path, and without a bound a port scanner
+    #: (or a path-building client bug) grows one persistent histogram
+    #: per probe for the server's lifetime. Overflow aggregates under
+    #: "_other". 64 >> the real endpoint count.
+    MAX_PATHS = 64
+
+    def observe(self, path: str, seconds: float, status: int = 200) -> None:
+        with self._mu:
+            h = self._hist.get(path)
+            if h is None:
+                if len(self._hist) >= self.MAX_PATHS:
+                    path = "_other"
+                    h = self._hist.get(path)
+                if h is None:
+                    h = self._hist[path] = LatencyHistogram()
+            h.record(seconds)
+            if status >= 400:
+                self._errors[path] = self._errors.get(path, 0) + 1
+
+    def record_batch(self, size: int) -> None:
+        """One coalesced device dispatch of ``size`` queries."""
+        with self._mu:
+            self._batches[size] = self._batches.get(size, 0) + 1
+
+    def record_cache(self, hit: bool) -> None:
+        """One synonym result-cache lookup."""
+        with self._mu:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def snapshot(self, total_compiles: int = 0) -> dict:
+        with self._mu:
+            endpoints = {}
+            for path, h in sorted(self._hist.items()):
+                endpoints[path] = {
+                    "count": h.n,
+                    "errors": self._errors.get(path, 0),
+                    "p50_ms": round(h.quantile(0.50) * 1e3, 3),
+                    "p95_ms": round(h.quantile(0.95) * 1e3, 3),
+                    "p99_ms": round(h.quantile(0.99) * 1e3, 3),
+                    "mean_ms": round(h.total / max(h.n, 1) * 1e3, 3),
+                    "max_ms": round(h.max * 1e3, 3),
+                }
+            return {
+                "endpoints": endpoints,
+                "coalesced_batch_sizes": {
+                    str(k): v for k, v in sorted(self._batches.items())
+                },
+                "synonym_cache": {
+                    "hits": self.cache_hits,
+                    "misses": self.cache_misses,
+                },
+                "compiles": {
+                    "total": int(total_compiles),
+                    "warmup": int(self.warmup_compiles),
+                    "post_warmup": int(total_compiles)
+                    - int(self.warmup_compiles),
+                },
+            }
 
 
 @contextlib.contextmanager

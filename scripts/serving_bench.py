@@ -1,26 +1,150 @@
-"""Measure the serving path: QPS + latency of ModelServer endpoints.
+"""Closed-loop serving benchmark: QPS + tail latency vs client count.
 
 The reference's separate-cluster topology serves queries from a live PS
 cluster (README.md:52-57, glint.Main); this repo restates that as
-serving.py's HTTP server over one loaded model (PARITY.md records the
-dissolution rationale). Round-4 verdict: nothing measured it. This
-script times the two production endpoints — /synonyms (device top-k
-under the single request lock) and /transform (device mean-vector) —
-under 1/4/16 concurrent closed-loop clients, reporting per-endpoint QPS
-and p50/p95 latency.
+serving.py's HTTP server over one loaded model. ISSUE 2 made every
+device dispatch on that path a member of a small pre-warmed shape family
+(power-of-two Q buckets, k buckets, chunked pulls), so the steady-state
+contract is: ZERO jit compiles during the measured window, at any client
+count.
 
-Writes SERVING_r05.json (repo root) with the usual non-TPU fallback
-marker. Env: GLINT_SERVE_PLATFORM, GLINT_SERVE_SECONDS (per cell,
-default 4), GLINT_SERVE_MODEL (saved model dir; default trains a small
-model on the reference fixture corpus).
+This script drives three cells under 1/4/16 concurrent closed-loop
+clients: /synonyms over a wide all-distinct word pool (every request
+misses the result cache — the GATED cell, measuring the coalesced,
+bucketed batch top-k device path), /synonyms_hot over a 64-word hot set
+(the zipf head, served by the versioned result cache), and /transform
+(bucketed device mean-vector, uncached). Clients run as separate
+PROCESSES (``--worker`` re-invocations of this file, no jax import) over
+raw keep-alive sockets with pre-serialized request bytes: an in-process
+load generator shares the GIL with the server's handler threads and
+measures its own interpreter contention as server tail latency. Workers
+rendezvous on a ready-file barrier, then all measure the same absolute
+wall-clock window. Each cell records QPS, p50/p95/p99 latency, and the
+server compile counter across the timed window (from /healthz); the run
+fails its checks if any window compiled, or if /synonyms p95 at 16
+clients exceeds 3x p95 at 1 client.
+
+Writes SERVING_BENCH.json (repo root) — comparable across PRs — with the
+usual non-TPU fallback marker. Env: GLINT_SERVE_PLATFORM,
+GLINT_SERVE_SECONDS (per cell, default 4), GLINT_SERVE_MODEL (saved
+model dir; default builds a random-table model at production shape —
+serving cost depends only on table dimensions), GLINT_SERVE_VOCAB /
+GLINT_SERVE_DIM (default model shape, 300000 x 128),
+GLINT_SERVE_MAX_BATCH (coalescer cap, default 64).
 """
 
 import http.client
 import json
 import os
+import socket
 import sys
-import threading
 import time
+
+
+def _read_response(sock, buf: bytearray):
+    """Minimal HTTP/1.1 keep-alive response reader: returns (status,
+    leftover) after consuming exactly one Content-Length-framed
+    response. The server always sends Content-Length (serving.py)."""
+    while True:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end >= 0:
+            break
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    head = bytes(buf[:head_end]).decode("latin-1")
+    status = int(head.split(None, 2)[1])
+    clen = 0
+    for line in head.split("\r\n")[1:]:
+        k, _, v = line.partition(":")
+        if k.strip().lower() == "content-length":
+            clen = int(v.strip())
+    body_end = head_end + 4 + clen
+    while len(buf) < body_end:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    del buf[:body_end]
+    return status
+
+
+def _worker_main(argv) -> None:
+    """Closed-loop client process. Builds raw request bytes once, warms
+    its connection, signals readiness (out_file + '.ready'), spins until
+    the start file names the shared window, then hammers the endpoint
+    inside [t_start, t_start + seconds). Runs before any jax/repo
+    import — the worker interpreter stays a lean HTTP client."""
+    host, port, path, seconds, offset, payload_file, start_file, out_file = (
+        argv
+    )
+    port, seconds = int(port), float(seconds)
+    with open(payload_file, "rb") as f:
+        bodies = f.read().splitlines()
+    reqs = [
+        (
+            f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(b)}\r\n\r\n"
+        ).encode("latin-1") + b
+        for b in bodies
+    ]
+    lats, errors = [], 0
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    buf = bytearray()
+    i = int(offset)
+
+    def one_request(record: bool) -> None:
+        nonlocal sock, buf, errors, i
+        req = reqs[i % len(reqs)]
+        i += 1
+        t0 = time.perf_counter()
+        try:
+            sock.sendall(req)
+            status = _read_response(sock, buf)
+            if status != 200:
+                errors += 1
+                return
+        except Exception:
+            errors += 1
+            sock.close()
+            sock = socket.create_connection((host, port), timeout=30)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            buf = bytearray()
+            return
+        if record:
+            lats.append(time.perf_counter() - t0)
+
+    try:
+        one_request(False)  # fault in connection + server handler thread
+        with open(out_file + ".ready", "w") as f:
+            f.write("ready")
+        t_start = None
+        deadline = time.time() + 120
+        while t_start is None and time.time() < deadline:
+            try:
+                with open(start_file) as f:
+                    t_start = float(f.read().strip())
+            except (OSError, ValueError):
+                time.sleep(0.002)
+        if t_start is None:
+            raise TimeoutError("no start signal")
+        while time.time() < t_start:
+            time.sleep(0.001)
+        while time.time() < t_start + seconds:
+            one_request(True)
+    finally:
+        sock.close()
+    with open(out_file, "w") as f:
+        json.dump({"lats": lats, "errors": errors}, f)
+
+
+if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+    _worker_main(sys.argv[2:])
+    sys.exit(0)
+
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -28,17 +152,27 @@ from glint_word2vec_tpu.utils.platform import force_platform  # noqa: E402
 
 force_platform(os.environ.get("GLINT_SERVE_PLATFORM"))
 
+import subprocess  # noqa: E402
+import tempfile  # noqa: E402
+
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
 OUT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "SERVING_r05.json",
+    "SERVING_BENCH.json",
 )
+CLIENTS = (1, 4, 16)
 
 
 def _build_model():
+    """GLINT_SERVE_MODEL serves a real saved model; the default is a
+    RANDOM-table model at production shape (GLINT_SERVE_VOCAB x
+    GLINT_SERVE_DIM, default 300k x 128). Serving cost is a function of
+    table dimensions only — training weights would not change a single
+    measured number, and the tiny fixture-corpus vocab (~200 rows) puts
+    the whole benchmark in the HTTP/python regime the device-dispatch
+    design is NOT about."""
     model_dir = os.environ.get("GLINT_SERVE_MODEL")
     from glint_word2vec_tpu.parallel.mesh import make_mesh
 
@@ -47,86 +181,87 @@ def _build_model():
         from glint_word2vec_tpu import load_model
 
         return load_model(model_dir, mesh=mesh)
-    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.corpus.vocab import Vocabulary
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+    from glint_word2vec_tpu.utils.params import Word2VecParams
 
-    return Word2Vec(
-        mesh=mesh, vector_size=100, batch_size=256, min_count=5,
-        num_iterations=1, seed=1, steps_per_call=16,
-    ).fit_file(CORPUS, lowercase=True)
+    V = int(os.environ.get("GLINT_SERVE_VOCAB", 300_000))
+    d = int(os.environ.get("GLINT_SERVE_DIM", 128))
+    vocab = Vocabulary.from_sorted(
+        [f"w{i}" for i in range(V)],
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+    )
+    engine = EmbeddingEngine(mesh, V, d, vocab.counts, seed=1)
+    return Word2VecModel(vocab, engine, Word2VecParams(vector_size=d))
 
 
-def _client_loop(host, port, path, payloads, stop, lats, errors):
+def _get(host, port, path):
     conn = http.client.HTTPConnection(host, port, timeout=30)
-    i = 0
     try:
-        while not stop.is_set():
-            body = payloads[i % len(payloads)]
-            i += 1
-            t0 = time.perf_counter()
-            try:
-                conn.request(
-                    "POST", path, body=body,
-                    headers={"Content-Type": "application/json"},
-                )
-                resp = conn.getresponse()
-                resp.read()
-                if resp.status != 200:
-                    errors.append(resp.status)
-                    continue
-            except Exception:
-                errors.append("conn")
-                conn.close()
-                conn = http.client.HTTPConnection(host, port, timeout=30)
-                continue
-            lats.append(time.perf_counter() - t0)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return json.loads(resp.read())
     finally:
         conn.close()
 
 
-def bench_endpoint(server, path, payloads, concurrency, seconds):
-    stop = threading.Event()
-    lats, errors = [], []
-    threads = [
-        threading.Thread(
-            target=_client_loop,
-            args=(server.host, server.port, path, payloads, stop, lats,
-                  errors),
-            daemon=True,
-        )
-        for _ in range(concurrency)
+def bench_endpoint(server, name, path, payload_file, concurrency, seconds,
+                   tmp, stride=7, base=0):
+    """One (cell name, client count) measurement. ``stride``/``base``
+    place each worker's walk through the payload pool: the hot cell
+    interleaves workers over a tiny pool (stride 7) so the result cache
+    sees zipf-like repeats; the cold cell gives each worker a disjoint
+    slice of a wide pool (stride >> requests/worker, per-cell base) so
+    every request misses the cache and pays the bucketed device path."""
+    tag = f"{name}_{concurrency}"
+    start_file = os.path.join(tmp, f"start_{tag}")
+    out_files = [
+        os.path.join(tmp, f"w_{tag}_{j}.json") for j in range(concurrency)
     ]
-    # Warm (compile the jitted query fns) before the timed window.
-    warm_stop = threading.Event()
-    wl, we = [], []
-    _client_loop_once = threading.Thread(
-        target=_client_loop,
-        args=(server.host, server.port, path, payloads[:1], warm_stop, wl,
-              we),
-        daemon=True,
-    )
-    _client_loop_once.start()
-    t0 = time.time()
-    while not wl and not we and time.time() - t0 < 120:
-        time.sleep(0.05)
-    warm_stop.set()
-    _client_loop_once.join(timeout=30)
-
-    for t in threads:
-        t.start()
-    time.sleep(seconds)
-    stop.set()
-    for t in threads:
-        t.join(timeout=30)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(server.host), str(server.port), path, str(seconds),
+             str(base + j * stride), payload_file, start_file, out_files[j]],
+        )
+        for j in range(concurrency)
+    ]
+    # Barrier: every worker has warmed its connection before the window.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if all(os.path.exists(f + ".ready") for f in out_files):
+            break
+        time.sleep(0.01)
+    t_start = time.time() + 0.3
+    with open(start_file + ".tmp", "w") as f:
+        f.write(str(t_start))
+    os.rename(start_file + ".tmp", start_file)
+    while time.time() < t_start:
+        time.sleep(0.01)
+    compiles_before = _get(server.host, server.port, "/healthz")["compiles"]
+    join_deadline = t_start + seconds + 60
+    for p in procs:
+        p.wait(timeout=max(1, join_deadline - time.time()))
+    compiles_after = _get(server.host, server.port, "/healthz")["compiles"]
+    lats, errors = [], 0
+    for f in out_files:
+        with open(f) as fh:
+            d = json.load(fh)
+        lats.extend(d["lats"])
+        errors += d["errors"]
     if not lats:
-        return {"error": f"no successful requests ({len(errors)} errors)"}
+        return {"error": f"no successful requests ({errors} errors)"}
     xs = np.asarray(sorted(lats))
     return {
         "concurrency": concurrency,
         "requests": len(lats),
-        "errors": len(errors),
+        "errors": errors,
         "qps": round(len(lats) / seconds, 1),
         "p50_ms": round(float(np.quantile(xs, 0.50)) * 1e3, 2),
         "p95_ms": round(float(np.quantile(xs, 0.95)) * 1e3, 2),
+        "p99_ms": round(float(np.quantile(xs, 0.99)) * 1e3, 2),
+        "compiles_during_window": compiles_after - compiles_before,
     }
 
 
@@ -135,48 +270,146 @@ def main():
 
     dev = jax.devices()[0]
     seconds = float(os.environ.get("GLINT_SERVE_SECONDS", 4.0))
+    max_batch = int(os.environ.get("GLINT_SERVE_MAX_BATCH", 64))
     model = _build_model()
-    server = ModelServer(model, port=0)  # ephemeral port
+    t0 = time.time()
+    server = ModelServer(model, port=0, max_batch=max_batch)  # ephemeral port
+    warmup_seconds = round(time.time() - t0, 2)
     server.start_background()
 
+    def device_floor(q):
+        """Min wall time of one bucketed batch top-k dispatch at Q=q —
+        the raw device cost a perfectly coalesced round pays. On a
+        compute-bound host (CPU fallback) floor(16)/floor(1) bounds any
+        achievable closed-loop p95 ratio from below; on bandwidth-bound
+        accelerator backends the two converge."""
+        rng_f = np.random.default_rng(1)
+        vecs = rng_f.standard_normal((q, model.vector_size)).astype(
+            np.float32
+        )
+        ts = []
+        for _ in range(10):
+            f0 = time.perf_counter()
+            model.engine.top_k_cosine_batch(vecs, 11)
+            ts.append(time.perf_counter() - f0)
+        return round(min(ts) * 1e3, 2)
+
+    floor1, floor16 = device_floor(1), device_floor(16)
+
     rng = np.random.default_rng(0)
-    hot = min(200, model.vocab.size)  # query the frequent rows
+    hot = min(200, model.vocab.size)  # the frequent rows
     words = [model.vocab.words[i] for i in rng.integers(0, hot, 64)]
-    syn_payloads = [
-        json.dumps({"word": w, "num": 10}).encode() for w in words
+    # Wide pool for the cold cells: distinct words across the whole
+    # vocab, each requested (at most) once per run via disjoint worker
+    # slices — every request misses the result cache and measures the
+    # coalesced, bucketed DEVICE path.
+    wide = [
+        model.vocab.words[i]
+        for i in rng.choice(
+            model.vocab.size, min(65536, model.vocab.size), replace=False
+        )
     ]
     sentences = [
         [model.vocab.words[j] for j in rng.integers(0, hot, 10)]
         for _ in range(16)
     ]
-    tr_payloads = [
-        json.dumps({"sentences": [s]}).encode() for s in sentences
-    ]
 
     out = {
-        "metric": "serving_qps",
+        "metric": "serving_bench",
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "vocab_size": model.vocab.size,
         "dim": model.vector_size,
+        "max_batch": server.max_batch,
+        "warmup_seconds": warmup_seconds,
+        "warmup_compiles": server.metrics.warmup_compiles,
+        "device_dispatch_ms": {
+            "q1": floor1,
+            "q16": floor16,
+            "ratio_16v1": round(floor16 / floor1, 2) if floor1 else None,
+        },
         "seconds_per_cell": seconds,
         "endpoints": {},
     }
     if dev.platform != "tpu":
         out["fallback"] = dev.platform
-    for path, payloads in (
-        ("/synonyms", syn_payloads), ("/transform", tr_payloads)
-    ):
-        cells = [
-            bench_endpoint(server, path, payloads, c, seconds)
-            for c in (1, 4, 16)
-        ]
-        out["endpoints"][path] = cells
+    with tempfile.TemporaryDirectory(prefix="serving_bench_") as tmp:
+        # (cell name, path, payload lines, worker stride): /synonyms is
+        # the GATED cell — disjoint slices of the wide pool, all cache
+        # misses, pure coalesced device dispatch. /synonyms_hot repeats
+        # a 64-word hot set (the zipf head) through the result cache.
+        wide_stride = max(1, len(wide) // 16)
+        # Cold payloads use a distinct num per concurrency level
+        # (10 + k, all inside the warmed k=16 bucket) so (word, num)
+        # cache keys can NEVER collide across cells — the gated cell
+        # stays all-miss regardless of window length or QPS.
+        cells = (
+            ("synonyms", "/synonyms",
+             lambda k: [json.dumps({"word": w, "num": 10 + k})
+                        for w in wide],
+             wide_stride),
+            ("synonyms_hot", "/synonyms",
+             lambda k: [json.dumps({"word": w, "num": 10})
+                        for w in words], 7),
+            ("transform", "/transform",
+             lambda k: [json.dumps({"sentences": [s]})
+                        for s in sentences], 7),
+        )
+        for name, path, make_lines, stride in cells:
+            rows = []
+            for k, c in enumerate(CLIENTS):
+                pf = os.path.join(tmp, f"{name}_{c}.jsonl")
+                with open(pf, "w") as f:
+                    f.write("\n".join(make_lines(k)))
+                rows.append(
+                    bench_endpoint(
+                        server, name, path, pf, c, seconds, tmp,
+                        stride=stride,
+                        # Disjoint walk bases per concurrency level on
+                        # the wide pool (second line of defense against
+                        # cross-cell repeats).
+                        base=(k * 1000 if stride > 7 else 0),
+                    )
+                )
+            out["endpoints"]["/" + name] = rows
+    out["metrics_snapshot"] = _get(server.host, server.port, "/metrics")
+
+    # The ISSUE 2 acceptance contract, recorded in the artifact itself.
+    cells = [
+        c for cs in out["endpoints"].values() for c in cs if "error" not in c
+    ]
+    def p95_ratio(cell_name):
+        by_c = {c["concurrency"]: c for c in out["endpoints"][cell_name]
+                if "error" not in c}
+        if 1 in by_c and 16 in by_c and by_c[1]["p95_ms"] > 0:
+            return round(by_c[16]["p95_ms"] / by_c[1]["p95_ms"], 2)
+        return None
+
+    ratio = p95_ratio("/synonyms")
+    out["checks"] = {
+        "zero_compiles_in_measured_windows": all(
+            c["compiles_during_window"] == 0 for c in cells
+        ),
+        "synonyms_p95_ratio_16v1": ratio,
+        "synonyms_p95_16v1_within_3x": ratio is not None and ratio <= 3.0,
+        "synonyms_hot_p95_ratio_16v1": p95_ratio("/synonyms_hot"),
+        # The raw device cost ratio of a Q=16 vs Q=1 bucketed dispatch:
+        # the closed-loop p95 ratio cannot go below it, whatever the
+        # serving layer does. On the CPU fallback the scoring GEMM is
+        # compute-bound (~4-5x); on bandwidth-bound accelerators it
+        # approaches 1 and the 3x contract becomes meaningful end to end.
+        "device_dispatch_ratio_16v1": out["device_dispatch_ms"][
+            "ratio_16v1"
+        ],
+    }
+
     server.stop()
     model.stop()
     with open(OUT, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
+    if not out["checks"]["zero_compiles_in_measured_windows"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

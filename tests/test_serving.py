@@ -153,6 +153,217 @@ def test_malformed_vector_fails_only_its_own_request(served):
     assert len(ok_res) == 1 and len(ok_res[0]) == 3
 
 
+def test_q_bucketing_exact_with_at_most_one_compile_per_bucket(served):
+    # Batched top-k pads Q to power-of-two buckets (engine.next_pow2) and
+    # rounds k up to its bucket; results must equal the single-query path
+    # for every batch size (padded rows can never win a real row's
+    # top-k), and the compile counter must grow at most once per NEW
+    # bucket across varied Q — zero times inside the warmed family.
+    server, model = served
+    engine = model.engine
+    rng = np.random.default_rng(3)
+
+    before = engine.query_compiles
+    for q in range(1, 10):
+        vecs = rng.standard_normal((q, model.vector_size)).astype(np.float32)
+        batch = model.find_synonyms_batch(vecs, 3)
+        assert len(batch) == q
+        for row, v in zip(batch, vecs):
+            single = model.find_synonyms_vector(v, 3)
+            assert [w for w, _ in row] == [w for w, _ in single]
+            np.testing.assert_allclose(
+                [s for _, s in row], [s for _, s in single], rtol=1e-5
+            )
+    # Q 1..9 and k=3 all land inside the warmed family (Q buckets
+    # 1..max_batch, k bucket TOPK_MIN_K_BUCKET): zero fresh compiles.
+    assert engine.query_compiles == before
+
+    # Past the warmed range, every Q in (64, 128] shares ONE bucket.
+    before = engine.query_compiles
+    for q in (65, 100, 128):
+        model.find_synonyms_batch(
+            rng.standard_normal((q, model.vector_size)).astype(np.float32), 3
+        )
+    assert engine.query_compiles == before + 1
+
+
+def test_chunked_coalesced_pull_matches_unchunked(served, monkeypatch):
+    # A coalesced batch larger than MAX_QUERY_ROWS must pull in chunks
+    # (the coalescer used to bypass the cap entirely) and match the
+    # unchunked gather bit-for-bit.
+    from glint_word2vec_tpu.models import word2vec as w2v_mod
+    from glint_word2vec_tpu.serving import _pull_coalesced
+
+    server, model = served
+    idx = np.arange(23, dtype=np.int32) % model.vocab.size
+    unchunked = np.asarray(model.engine.pull(idx), np.float32)
+    monkeypatch.setattr(w2v_mod, "MAX_QUERY_ROWS", 8)
+    chunked = _pull_coalesced(model.engine, idx)
+    np.testing.assert_array_equal(chunked, unchunked)
+
+
+def test_coalescer_chunks_at_max_batch(served):
+    # A drained pending list larger than max_batch is served in
+    # max_batch-sized device dispatches, each recorded in the
+    # coalesced-batch-size distribution, with per-request results still
+    # exactly the single-query answers.
+    import threading
+
+    from glint_word2vec_tpu.serving import _SynonymCoalescer
+    from glint_word2vec_tpu.utils.metrics import ServingMetrics
+
+    _, model = served
+    metrics = ServingMetrics()
+    co = _SynonymCoalescer(
+        model, threading.Lock(), max_batch=2, metrics=metrics
+    )
+    words = [model.vocab.words[i] for i in range(5)]
+    batch = [
+        {"word": w, "vector": None, "num": 3, "event": threading.Event(),
+         "result": None, "error": None}
+        for w in words
+    ]
+    co._process(batch)
+    for r, w in zip(batch, words):
+        assert r["event"].is_set() and r["error"] is None
+        expect = model.find_synonyms(w, 3)
+        assert [x[0] for x in r["result"]] == [x[0] for x in expect]
+    sizes = metrics.snapshot()["coalesced_batch_sizes"]
+    assert sizes == {"1": 1, "2": 2}
+
+
+def test_smoke_every_endpoint_zero_post_warmup_compiles(served):
+    # The CI serving smoke (ISSUE 2): a freshly warmed ModelServer
+    # answers every endpoint once plus a concurrent coalesced burst
+    # without a single post-warmup jit compile, and /metrics shows the
+    # latency histograms and batch-size distribution filling in.
+    import threading
+
+    _, model = served
+    smoke = ModelServer(model, port=0)
+    smoke.start_background()
+    try:
+        w0, w1 = model.vocab.words[0], model.vocab.words[1]
+        _post(smoke, "/synonyms", {"word": w0, "num": 5})
+        _post(smoke, "/synonyms_vector",
+              {"vector": [float(x) for x in model.transform(w0)], "num": 4})
+        _post(smoke, "/analogy",
+              {"positive": [w0], "negative": [w1], "num": 3})
+        _post(smoke, "/vector", {"word": w0})
+        _post(smoke, "/transform", {"sentences": [[w0, w1, w0]]})
+        # Multi-sentence transforms exercise the (rows, len) grid: both
+        # dims bucket to powers of two inside the warmed family (a
+        # 3-sentence request once compiled post-warmup because only
+        # rows=1 was warmed).
+        _post(smoke, "/transform", {"sentences": [[w0], [w1], [w0, w1]]})
+
+        burst_words = [model.vocab.words[i % model.vocab.size]
+                       for i in range(12)]
+        errs = []
+
+        def hit(w):
+            try:
+                _post(smoke, "/synonyms", {"word": w, "num": 6})
+            except Exception as e:  # pragma: no cover - burst must succeed
+                errs.append(e)
+
+        threads = [threading.Thread(target=hit, args=(w,))
+                   for w in burst_words]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+
+        with urllib.request.urlopen(
+            f"http://{smoke.host}:{smoke.port}/healthz", timeout=30
+        ) as r:
+            health = json.loads(r.read())
+        assert health["post_warmup_compiles"] == 0
+        with urllib.request.urlopen(
+            f"http://{smoke.host}:{smoke.port}/metrics", timeout=30
+        ) as r:
+            metrics = json.loads(r.read())
+        assert metrics["compiles"]["post_warmup"] == 0
+        assert metrics["compiles"]["warmup"] >= 0
+        syn = metrics["endpoints"]["/synonyms"]
+        assert syn["count"] >= 13 and syn["errors"] == 0
+        assert syn["p95_ms"] >= syn["p50_ms"] >= 0
+        assert metrics["coalesced_batch_sizes"]  # burst coalesced
+        for path in ("/synonyms_vector", "/analogy", "/vector",
+                     "/transform"):
+            assert metrics["endpoints"][path]["count"] >= 1
+    finally:
+        smoke.stop()
+
+
+def test_synonym_cache_hit_invalidation_and_bound(served):
+    # The (word, num) result cache: a repeat query is served without a
+    # device dispatch, any table mutation (engine.table_version tick)
+    # empties it wholesale, and the entry count never exceeds
+    # cache_size (FIFO eviction).
+    import threading
+
+    from glint_word2vec_tpu.serving import _SynonymCoalescer
+    from glint_word2vec_tpu.utils.metrics import ServingMetrics
+
+    _, model = served
+    metrics = ServingMetrics()
+    co = _SynonymCoalescer(
+        model, threading.Lock(), metrics=metrics, cache_size=2
+    )
+    w = model.vocab.words[0]
+    dispatches = []
+    orig = model.find_synonyms_batch
+    model.find_synonyms_batch = (
+        lambda *a, **k: dispatches.append(1) or orig(*a, **k)
+    )
+    try:
+        first = co.query(word=w, num=4)
+        again = co.query(word=w, num=4)
+        assert again == first and len(dispatches) == 1
+        snap = metrics.snapshot()["synonym_cache"]
+        assert snap == {"hits": 1, "misses": 1}
+
+        # A real table mutation (same values, so results are unchanged)
+        # ticks table_version and must empty the cache.
+        ver = model.engine.table_version
+        row0 = np.asarray(model.engine.pull(np.zeros(1, np.int32)))
+        model.engine.write_rows(0, row0[:, : model.engine.dim])
+        assert model.engine.table_version > ver
+        third = co.query(word=w, num=4)
+        assert len(dispatches) == 2
+        assert [x[0] for x in third] == [x[0] for x in first]
+
+        # FIFO bound: filling past cache_size=2 evicts the oldest.
+        for i in range(4):
+            co.query(word=model.vocab.words[i], num=3)
+        assert len(co._cache) <= 2
+    finally:
+        model.find_synonyms_batch = orig
+
+
+def test_cache_disabled_always_dispatches(served):
+    import threading
+
+    from glint_word2vec_tpu.serving import _SynonymCoalescer
+
+    _, model = served
+    co = _SynonymCoalescer(model, threading.Lock(), cache_size=0)
+    w = model.vocab.words[1]
+    dispatches = []
+    orig = model.find_synonyms_batch
+    model.find_synonyms_batch = (
+        lambda *a, **k: dispatches.append(1) or orig(*a, **k)
+    )
+    try:
+        co.query(word=w, num=4)
+        co.query(word=w, num=4)
+        assert len(dispatches) == 2 and not co._cache
+    finally:
+        model.find_synonyms_batch = orig
+
+
 def test_num_zero_and_negative_match_single_query_semantics(served):
     server, model = served
     w = model.vocab.words[0]
