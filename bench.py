@@ -138,6 +138,62 @@ def _flops_per_step(mode: str, cfg, mask_density: float) -> float:
 # ----------------------------------------------------------------------
 
 
+def _bench_obs_overhead(jax, np):
+    """ISSUE 3 overhead guard: a fit with the full observability stack
+    enabled (event ring + JSONL sink + Chrome-trace export + heartbeat
+    server + status file + warn canary) must stay within 3% words/sec of
+    the same fit with observability off. Runs the real production fit
+    (device-resident corpus path) three times — warm-up (compiles,
+    discarded), baseline, instrumented — and reports both throughputs
+    plus the overhead fraction. Mode name: ``obs_overhead`` in
+    BENCH_MODES (not in the default set; words/sec here is from a small
+    fit, not comparable to the engine-loop modes)."""
+    import tempfile
+
+    from glint_word2vec_tpu.models.word2vec import Word2Vec
+    from glint_word2vec_tpu.obs import ObsConfig
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    n_words = int(os.environ.get("BENCH_OBS_WORDS", 400_000))
+    vocab = [f"w{i}" for i in range(2000)]
+    sent_len = 20
+    sentences = [
+        [vocab[j] for j in rng.integers(0, len(vocab), sent_len)]
+        for _ in range(n_words // sent_len)
+    ]
+
+    def run(obs):
+        model = Word2Vec(
+            mesh=make_mesh(1, 1), obs=obs, vector_size=64, min_count=1,
+            batch_size=1024, num_iterations=2, seed=1, steps_per_call=8,
+        ).fit(sentences)
+        wps = model.training_metrics["words_per_sec"]
+        pipeline = model.training_metrics["pipeline"]
+        model.stop()
+        return wps, pipeline
+
+    run(None)  # compile warm-up fit, discarded
+    base, pipeline = run(None)
+    with tempfile.TemporaryDirectory() as td:
+        obs = ObsConfig(
+            event_log=os.path.join(td, "events.jsonl"),
+            chrome_trace=os.path.join(td, "trace.json"),
+            status_port=0,
+            status_file=os.path.join(td, "status.json"),
+            canary="warn",
+        )
+        instrumented, _ = run(obs)
+    return {
+        "words_per_sec": instrumented,
+        "words_per_sec_baseline": base,
+        "overhead_frac": round(1.0 - instrumented / base, 4),
+        "corpus_words": n_words,
+        "pipeline": pipeline,
+        "inputs": "fit_list",
+    }
+
+
 def _mode_parts(mode: str):
     """Split a mode name into (estimator, compute_dtype, table_dtype).
 
@@ -162,6 +218,8 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     V, d, B = cfg["vocab"], cfg["dim"], cfg["batch"]
     spc, C, n = cfg["steps_per_call"], cfg["context_lanes"], cfg["negatives"]
     estimator, compute_dtype, table_dtype = _mode_parts(mode)
+    if estimator == "obs_overhead":
+        return _bench_obs_overhead(jax, np)
     shared = cfg["shared_negatives"] if estimator == "shared" else 0
 
     # Zipf-ish counts: realistic index skew for gathers and the noise table.
@@ -413,12 +471,12 @@ def worker_main() -> None:
             results[mode] = {"error": f"{type(e).__name__}: {e}"}  # tunnel)
             _flush_partial()
             continue
-        if peak:
+        if peak and "flops_per_sec" in r:
             r["mfu"] = round(r.pop("flops_per_sec") / peak, 4)
             r["peak_flops_assumed"] = peak
             peaks[mode] = peak
         else:
-            r.pop("flops_per_sec")
+            r.pop("flops_per_sec", None)
         results[mode] = r
         _flush_partial()
 

@@ -39,6 +39,8 @@ __all__ = [
     "load_model",
     "ServerSideGlintWord2Vec",
     "ServerSideGlintWord2VecModel",
+    "ObsConfig",
+    "TrainingDiverged",
 ]
 
 
@@ -60,6 +62,11 @@ def __getattr__(name):
         from glint_word2vec_tpu.utils.params import Word2VecParams
 
         return Word2VecParams
+    if name in ("ObsConfig", "TrainingDiverged"):
+        # Run-wide observability (obs/): heartbeat, event log, canary.
+        from glint_word2vec_tpu import obs
+
+        return getattr(obs, name)
     if name in ("ServerSideGlintWord2Vec", "ServerSideGlintWord2VecModel"):
         # Reference-surface compatibility layer (compat.py): the PySpark
         # binding API re-exposed over this framework.

@@ -20,6 +20,8 @@ import json
 import logging
 import sys
 
+from glint_word2vec_tpu.obs.canary import TrainingDiverged
+
 
 def _add_train(sub):
     p = sub.add_parser("train", help="train a model from a text corpus")
@@ -56,7 +58,51 @@ def _add_train(sub):
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--metrics-out", default=None,
-                   help="write training metrics JSON here")
+                   help="write training metrics JSON here (atomic write)")
+    obs = p.add_argument_group(
+        "observability",
+        "run-wide observability: live heartbeat, span event log, "
+        "divergence canary (all opt-in, zero overhead when off)",
+    )
+    obs.add_argument("--status-port", type=int, default=None,
+                     help="serve a live training heartbeat on this port: "
+                          "GET /healthz and /metrics (JSON by default; "
+                          "?format=prometheus for scrape-ready text). "
+                          "0 binds an ephemeral port")
+    obs.add_argument("--status-host", default="127.0.0.1",
+                     help="heartbeat bind address (default 127.0.0.1)")
+    obs.add_argument("--status-file", default=None,
+                     help="atomically mirror the status snapshot JSON to "
+                          "this path (for multihost workers that can't "
+                          "bind ports)")
+    obs.add_argument("--event-log", default=None,
+                     help="JSONL span/event log of the fit's phases "
+                          "(subsample-compact, host batching, device "
+                          "dispatch, checkpoints) plus engine events "
+                          "(table mutations, query-shape compiles)")
+    obs.add_argument("--event-capacity", type=int, default=65536,
+                     help="in-memory event ring bound; overflow is "
+                          "counted, never unbounded (default 65536)")
+    obs.add_argument("--chrome-trace", default=None,
+                     help="write the event log as chrome://tracing / "
+                          "Perfetto JSON at run end (merge with device "
+                          "xplane tables via scripts/trace_summarize.py "
+                          "--host-spans)")
+    obs.add_argument("--canary", choices=["off", "warn", "abort"],
+                     default="off",
+                     help="divergence canary over a rolling loss window: "
+                          "'warn' logs and records an event; 'abort' "
+                          "writes a final ckpt-diverged snapshot + "
+                          "flushes the event log, then fails the run")
+    obs.add_argument("--canary-window", type=int, default=64,
+                     help="rolling loss window size (default 64)")
+    obs.add_argument("--canary-factor", type=float, default=10.0,
+                     help="trip when loss exceeds factor x the window "
+                          "median (default 10.0); NaN/Inf always trips")
+    obs.add_argument("--canary-check-every", type=int, default=32,
+                     help="steps between canary loss syncs; each check "
+                          "blocks the async dispatch pipeline for one "
+                          "device sync (default 32)")
     p.add_argument("--fasttext", action="store_true",
                    help="train the subword (fastText-style) family")
     p.add_argument("--min-n", type=int, default=3,
@@ -129,6 +175,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     try:
         return _run(args)
+    except TrainingDiverged as e:
+        # The canary already wrote the final checkpoint and flushed the
+        # event log; the operator needs the reason, not a stack trace.
+        print(f"error: training diverged: {e}", file=sys.stderr)
+        return 2
     except (KeyError, ValueError, FileNotFoundError) as e:
         # Expected user errors (OOV word, bad path, bad params): one clean
         # line, no traceback.
@@ -164,13 +215,31 @@ def _run(args) -> int:
             steps_per_call=args.steps_per_call,
             shared_negatives=args.shared_negatives,
         )
+        obs = None
+        if (args.status_port is not None or args.status_file
+                or args.event_log or args.chrome_trace
+                or args.canary != "off"):
+            from glint_word2vec_tpu.obs import ObsConfig
+
+            obs = ObsConfig(
+                event_log=args.event_log,
+                event_capacity=args.event_capacity,
+                chrome_trace=args.chrome_trace,
+                status_port=args.status_port,
+                status_host=args.status_host,
+                status_file=args.status_file,
+                canary=args.canary,
+                canary_window=args.canary_window,
+                canary_factor=args.canary_factor,
+                canary_check_every=args.canary_check_every,
+            )
         if args.fasttext:
             w2v = FastTextWord2Vec(
-                **kw, min_n=args.min_n, max_n=args.max_n,
+                **kw, obs=obs, min_n=args.min_n, max_n=args.max_n,
                 bucket=args.bucket, max_subwords=args.max_subwords,
             )
         else:
-            w2v = Word2Vec(**kw)
+            w2v = Word2Vec(**kw, obs=obs)
         # Streaming ingestion (fit_file): two passes over the file, flat
         # int32 encoding — never materializes Python sentence lists.
         model = w2v.fit_file(
@@ -180,8 +249,9 @@ def _run(args) -> int:
         model.save(args.output)
         print(json.dumps({"saved": args.output, **(model.training_metrics or {})}))
         if args.metrics_out:
-            with open(args.metrics_out, "w") as f:
-                json.dump(model.training_metrics, f)
+            from glint_word2vec_tpu.utils import atomic_write_json
+
+            atomic_write_json(args.metrics_out, model.training_metrics)
         return 0
 
     if args.cmd == "serve":

@@ -17,6 +17,7 @@ import numpy as np
 
 from glint_word2vec_tpu.corpus.subword import build_subword_table, subword_group
 from glint_word2vec_tpu.corpus.vocab import Vocabulary
+from glint_word2vec_tpu.obs import events as obs_events
 from glint_word2vec_tpu.models.word2vec import (
     MAX_QUERY_ROWS,
     LocalWord2VecModel,
@@ -100,11 +101,16 @@ class FastTextWord2Vec(Word2Vec):
     def _train_batches(self, engine, batches, base_key, step0, alphas):
         # Host-side expansion of center words to their subword groups;
         # padded batch rows (center 0) carry zero context masks, so their
-        # group updates are zeroed by the gradient coefficients.
-        centers_k = np.stack([b.centers for b in batches])
+        # group updates are zeroed by the gradient coefficients. The
+        # expansion is this family's extra host-side phase, so it gets
+        # its own span inside the fit loop's device_steps window.
+        with obs_events.span("subword_expand", step0=step0):
+            centers_k = np.stack([b.centers for b in batches])
+            groups = self._sub_ids[centers_k]
+            gmask = self._sub_mask[centers_k]
         return engine.train_steps_grouped(
-            self._sub_ids[centers_k],
-            self._sub_mask[centers_k],
+            groups,
+            gmask,
             np.stack([b.contexts for b in batches]),
             np.stack([b.mask for b in batches]),
             base_key,
@@ -228,21 +234,24 @@ class FastTextModel(Word2VecModel):
         if getattr(self, "_qeng", None) is None:
             from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
 
-            qeng = EmbeddingEngine(
-                self.engine.mesh,
-                self.vocab.size,
-                self.vector_size,
-                self.vocab.counts,
-                num_negatives=self.engine.num_negatives,
-                seed=0,
-            )
-            B = self.COMPOSE_BLOCK
-            for s in range(0, self.vocab.size, B):
-                e = min(s + B, self.vocab.size)
-                block = self._compose_device(
-                    self._sub_ids[s:e], self._sub_mask[s:e]
+            with obs_events.span(
+                "compose_query_engine", vocab=self.vocab.size
+            ):
+                qeng = EmbeddingEngine(
+                    self.engine.mesh,
+                    self.vocab.size,
+                    self.vector_size,
+                    self.vocab.counts,
+                    num_negatives=self.engine.num_negatives,
+                    seed=0,
                 )
-                qeng.write_rows(s, block)
+                B = self.COMPOSE_BLOCK
+                for s in range(0, self.vocab.size, B):
+                    e = min(s + B, self.vocab.size)
+                    block = self._compose_device(
+                        self._sub_ids[s:e], self._sub_mask[s:e]
+                    )
+                    qeng.write_rows(s, block)
             self._qeng = qeng
         return self._qeng
 

@@ -35,6 +35,7 @@ from glint_word2vec_tpu.corpus.batching import (
     encode_sentences,
 )
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.obs import TrainingDiverged, start_run
 from glint_word2vec_tpu.utils import next_pow2
 from glint_word2vec_tpu.utils.metrics import TrainingMetrics
 from glint_word2vec_tpu.utils.params import Word2VecParams
@@ -76,6 +77,19 @@ def _flip_checkpoint_state(
             )
 
 
+def _save_diverged_snapshot(engine, checkpoint_dir, obs_run) -> None:
+    """Canary abort tail shared by both fit loops: the event log is
+    already flushed (ObsRun); leave a final table snapshot for the
+    post-mortem WITHOUT flipping train_state.json — a resume must
+    restart from the last healthy checkpoint, not the diverged tables."""
+    if not checkpoint_dir:
+        return
+    ck = os.path.join(checkpoint_dir, "ckpt-diverged")
+    with obs_run.span("checkpoint_save", ckpt="ckpt-diverged"):
+        engine.save(ck)
+    logger.error("canary abort: diverged tables saved to %s", ck)
+
+
 class Word2Vec:
     """Skip-gram/negative-sampling estimator over a TPU mesh.
 
@@ -94,10 +108,15 @@ class Word2Vec:
         self,
         params: Optional[Word2VecParams] = None,
         mesh=None,
+        obs=None,
         **overrides,
     ):
         self.params = (params or Word2VecParams()).replace(**overrides)
         self.mesh = mesh
+        #: Optional obs.ObsConfig: run-scoped observability (event log,
+        #: heartbeat, canary). Like ``mesh``, it is run config — never
+        #: part of Word2VecParams or the saved model.
+        self.obs = obs
 
     # Fluent setters (reference mllib:92-243 / python bindings :172-302).
     def _set(self, **kw) -> "Word2Vec":
@@ -164,6 +183,12 @@ class Word2Vec:
         """Shared noise-pool size per step (0 = per-pair reference
         semantics; see Word2VecParams.shared_negatives)."""
         return self._set(shared_negatives=v)
+
+    def set_observability(self, obs) -> "Word2Vec":
+        """Attach an :class:`obs.ObsConfig` for subsequent fits (event
+        log, live heartbeat, status file, divergence canary)."""
+        self.obs = obs
+        return self
 
     # ------------------------------------------------------------------
 
@@ -388,106 +413,142 @@ class Word2Vec:
                 f"data-axis size ({mesh.shape['data']})"
             )
         engine = self._make_engine(mesh, vocab)
-        engine.upload_corpus(ids, offsets)
-        if subsampling:
-            engine.set_keep_probs(
-                vocab.device_keep_probabilities(p.subsample_ratio)
-            )
-        N = int(ids.shape[0])
-        B, spc = p.batch_size, p.steps_per_call
         twc = vocab.train_words_count
-        total_words = p.num_iterations * twc + 1
-        base_key = jax.random.PRNGKey(p.seed)
-        step = 0
-        start_epoch = 0
-
-        state_path = (
-            os.path.join(checkpoint_dir, "train_state.json")
-            if checkpoint_dir
-            else None
+        obs_run = start_run(
+            self.obs, pipeline="device_corpus",
+            total_epochs=p.num_iterations,
+            total_words=p.num_iterations * twc, engine=engine,
         )
-        if state_path and os.path.exists(state_path):
-            with open(state_path) as f:
-                state = json.load(f)
-            engine.load_tables(os.path.join(checkpoint_dir, state["ckpt"]))
-            start_epoch = state["epochs_completed"]
-            step = state["step"]
-            logger.info(
-                "resuming after epoch %d (step %d)", start_epoch, step
-            )
-        metrics = TrainingMetrics(base_words=start_epoch * twc)
-
-        for epoch in range(start_epoch, p.num_iterations):
+        try:
+            with obs_run.span("upload_corpus", words=int(ids.shape[0])):
+                engine.upload_corpus(ids, offsets)
             if subsampling:
-                # The epoch's subsample draws are keyed by epoch alone
-                # (the reference reseeds per iteration, mllib:371-373),
-                # so a resumed run recompacts epoch e to the identical
-                # buffers — no compaction state needs checkpointing.
-                with metrics.timing("step"):
-                    n_pos = engine.compact_corpus(
-                        jax.random.fold_in(base_key, epoch)
-                    )
-                offsets_c = engine.compacted_offsets()
-            else:
-                n_pos, offsets_c = N, None
-            steps_per_epoch = max(1, -(-n_pos // B))
-            groups = max(1, -(-steps_per_epoch // spc))
-            for g in range(groups):
-                start_pos = g * spc * B
-                with metrics.timing("host"):
-                    # LR anneal: the host batcher's pre-subsampling
-                    # words_done accounting — from the original offsets
-                    # alone, or looked up through the epoch's compacted
-                    # offsets when subsampling.
-                    alphas = np.empty(spc, np.float32)
-                    wds = np.empty(spc, np.int64)
-                    for j in range(spc):
-                        end_pos = min(start_pos + (j + 1) * B, n_pos)
-                        if subsampling:
-                            done = corpus_words_done_compacted(
-                                offsets, offsets_c, end_pos, n_pos
-                            )
-                        else:
-                            done = corpus_words_done(offsets, end_pos)
-                        wd = epoch * twc + done
-                        wds[j] = wd
-                        alphas[j] = max(
-                            p.step_size * (1 - wd / total_words),
-                            p.step_size * 1e-4,
-                        )
-                # An epoch subsampled to nothing dispatches its one
-                # no-op group but records no steps — the host batcher
-                # likewise yields no batches then.
-                n_real = min(spc, max(0, -(-(n_pos - start_pos) // B)))
-                with metrics.timing("step"):
-                    losses = engine.train_steps_corpus(
-                        start_pos, B, p.window, base_key, alphas, step
-                    )
-                    for i in range(n_real):
-                        step += 1
-                        metrics.record_step(
-                            int(wds[i]), loss=losses[i],
-                            alpha=float(alphas[i]),
-                        )
-                step += spc - n_real  # tail no-op steps consumed keys
-            stopping = (
-                stop_after_epochs is not None
-                and (epoch + 1 - start_epoch) >= stop_after_epochs
-            )
-            if state_path and (
-                stopping
-                or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
-            ):
-                ck_name = f"ckpt-{epoch + 1}"
-                engine.save(os.path.join(checkpoint_dir, ck_name))
-                _flip_checkpoint_state(
-                    checkpoint_dir, state_path, ck_name,
-                    epochs_completed=epoch + 1, step=step,
-                    words_done=(epoch + 1) * twc,
+                engine.set_keep_probs(
+                    vocab.device_keep_probabilities(p.subsample_ratio)
                 )
-            if stopping:
-                logger.info("stopping early after epoch %d", epoch + 1)
-                break
+            N = int(ids.shape[0])
+            B, spc = p.batch_size, p.steps_per_call
+            total_words = p.num_iterations * twc + 1
+            base_key = jax.random.PRNGKey(p.seed)
+            step = 0
+            start_epoch = 0
+
+            state_path = (
+                os.path.join(checkpoint_dir, "train_state.json")
+                if checkpoint_dir
+                else None
+            )
+            if state_path and os.path.exists(state_path):
+                with open(state_path) as f:
+                    state = json.load(f)
+                with obs_run.span("checkpoint_restore", ckpt=state["ckpt"]):
+                    engine.load_tables(
+                        os.path.join(checkpoint_dir, state["ckpt"])
+                    )
+                start_epoch = state["epochs_completed"]
+                step = state["step"]
+                logger.info(
+                    "resuming after epoch %d (step %d)", start_epoch, step
+                )
+            metrics = TrainingMetrics(base_words=start_epoch * twc)
+            obs_run.attach_metrics(metrics)
+
+            for epoch in range(start_epoch, p.num_iterations):
+                obs_run.update(epoch=epoch)
+                if subsampling:
+                    # The epoch's subsample draws are keyed by epoch alone
+                    # (the reference reseeds per iteration, mllib:371-373),
+                    # so a resumed run recompacts epoch e to the identical
+                    # buffers — no compaction state needs checkpointing.
+                    with metrics.timing("step"), obs_run.span(
+                        "subsample_compact", epoch=epoch
+                    ):
+                        n_pos = engine.compact_corpus(
+                            jax.random.fold_in(base_key, epoch)
+                        )
+                    offsets_c = engine.compacted_offsets()
+                else:
+                    n_pos, offsets_c = N, None
+                steps_per_epoch = max(1, -(-n_pos // B))
+                groups = max(1, -(-steps_per_epoch // spc))
+                for g in range(groups):
+                    start_pos = g * spc * B
+                    with metrics.timing("host"), obs_run.span(
+                        "host_batch", epoch=epoch, group=g
+                    ):
+                        # LR anneal: the host batcher's pre-subsampling
+                        # words_done accounting — from the original offsets
+                        # alone, or looked up through the epoch's compacted
+                        # offsets when subsampling.
+                        alphas = np.empty(spc, np.float32)
+                        wds = np.empty(spc, np.int64)
+                        for j in range(spc):
+                            end_pos = min(start_pos + (j + 1) * B, n_pos)
+                            if subsampling:
+                                done = corpus_words_done_compacted(
+                                    offsets, offsets_c, end_pos, n_pos
+                                )
+                            else:
+                                done = corpus_words_done(offsets, end_pos)
+                            wd = epoch * twc + done
+                            wds[j] = wd
+                            alphas[j] = max(
+                                p.step_size * (1 - wd / total_words),
+                                p.step_size * 1e-4,
+                            )
+                    # An epoch subsampled to nothing dispatches its one
+                    # no-op group but records no steps — the host batcher
+                    # likewise yields no batches then.
+                    n_real = min(spc, max(0, -(-(n_pos - start_pos) // B)))
+                    with metrics.timing("step"), obs_run.span(
+                        "device_steps", step0=step, n=n_real
+                    ):
+                        losses = engine.train_steps_corpus(
+                            start_pos, B, p.window, base_key, alphas, step
+                        )
+                        for i in range(n_real):
+                            step += 1
+                            metrics.record_step(
+                                int(wds[i]), loss=losses[i],
+                                alpha=float(alphas[i]),
+                            )
+                        # Inside the step bucket: the canary's periodic
+                        # loss sync waits on the device, and device waits
+                        # outside both buckets would skew host_frac.
+                        obs_run.observe_losses(step - n_real, losses, n_real)
+                    if n_real:
+                        obs_run.update(
+                            step=step, words_done=int(wds[n_real - 1]),
+                            alpha=float(alphas[n_real - 1]),
+                        )
+                    step += spc - n_real  # tail no-op steps consumed keys
+                stopping = (
+                    stop_after_epochs is not None
+                    and (epoch + 1 - start_epoch) >= stop_after_epochs
+                )
+                if state_path and (
+                    stopping
+                    or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
+                ):
+                    ck_name = f"ckpt-{epoch + 1}"
+                    with obs_run.span("checkpoint_save", ckpt=ck_name):
+                        engine.save(os.path.join(checkpoint_dir, ck_name))
+                        _flip_checkpoint_state(
+                            checkpoint_dir, state_path, ck_name,
+                            epochs_completed=epoch + 1, step=step,
+                            words_done=(epoch + 1) * twc,
+                        )
+                if stopping:
+                    logger.info("stopping early after epoch %d", epoch + 1)
+                    break
+        except TrainingDiverged:
+            _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
+            raise
+        except BaseException:
+            obs_run.close(failed=True)
+            raise
+        finally:
+            obs_run.close()
         logger.info("training done: %s", metrics.summary())
         model = self._make_model(vocab, engine)
         model.training_metrics = {
@@ -579,204 +640,248 @@ class Word2Vec:
                 "whole data rows (set num_partitions accordingly)"
             )
         engine = self._make_engine(mesh, vocab)
-        # LR schedule denominator: iterations * total train words + 1
-        # (reference ``totalWordsCount``, mllib:405-410).
-        total_words = p.num_iterations * vocab.train_words_count + 1
-        base_key = jax.random.PRNGKey(p.seed)
-        step = 0
-        start_epoch = 0
-
-        state_path = (
-            os.path.join(checkpoint_dir, "train_state.json")
-            if checkpoint_dir
-            else None
+        obs_run = start_run(
+            self.obs, pipeline="host", total_epochs=p.num_iterations,
+            total_words=p.num_iterations * vocab.train_words_count,
+            engine=engine,
         )
-        if state_path and os.path.exists(state_path):
-            with open(state_path) as f:
-                state = json.load(f)
-            if "ckpt" in state:
-                engine.load_tables(
-                    os.path.join(checkpoint_dir, state["ckpt"])
-                )
-            else:  # legacy single-file layout
-                engine.set_tables(
-                    np.load(os.path.join(checkpoint_dir, "ckpt", "syn0.npy")),
-                    np.load(os.path.join(checkpoint_dir, "ckpt", "syn1.npy")),
-                )
-            start_epoch = state["epochs_completed"]
-            step = state["step"]
-            batcher.words_done = state["words_done"]
-            logger.info(
-                "resuming after epoch %d (step %d)", start_epoch, step
+        try:
+            # LR schedule denominator: iterations * total train words + 1
+            # (reference ``totalWordsCount``, mllib:405-410).
+            total_words = p.num_iterations * vocab.train_words_count + 1
+            base_key = jax.random.PRNGKey(p.seed)
+            step = 0
+            start_epoch = 0
+
+            state_path = (
+                os.path.join(checkpoint_dir, "train_state.json")
+                if checkpoint_dir
+                else None
             )
-        # Metrics count only THIS invocation's work; on resume the restored
-        # global counter must not inflate throughput numbers.
-        metrics = TrainingMetrics(base_words=batcher.words_done)
-
-        def save_checkpoint(epochs_completed: int) -> None:
-            # Atomic: the sharded table snapshot lands in a fresh directory
-            # first; state.json (atomic rename) flips to it last, so a crash
-            # mid-write can never yield a state file pointing at mismatched
-            # or partial tables. Older snapshot dirs are pruned after.
-            # Multi-host: every process writes its own table shards
-            # (engine.save), then a barrier ensures all shards are on disk
-            # before process 0 alone flips state.json and prunes — per-host
-            # counters can diverge only by padding, and a lone writer keeps
-            # the flip atomic.
-            ck_name = f"ckpt-{epochs_completed}"
-            engine.save(os.path.join(checkpoint_dir, ck_name))
-            if pc > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices(
-                    f"glint_w2v_ckpt_{epochs_completed}"
-                )
-            if jax.process_index() == 0:
-                # words_done feeds the resumed run's metrics base and the
-                # single-host LR accounting; under the multi-host schedule
-                # the global pro-rata count is the coherent value (the local
-                # batcher count is per-shard and would mix units).
-                wd = (
-                    batcher.words_done
-                    if steps_per_epoch is None
-                    else epochs_completed * vocab.train_words_count
-                )
-                _flip_checkpoint_state(
-                    checkpoint_dir, state_path, ck_name,
-                    epochs_completed=epochs_completed, step=step,
-                    words_done=wd,
-                )
-            if pc > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices(
-                    f"glint_w2v_ckpt_done_{epochs_completed}"
-                )
-
-        spc = p.steps_per_call
-        twc = vocab.train_words_count
-        # Multi-host: steps_per_epoch fixes the dispatch count; groups are
-        # the scan-length quantized version of it.
-        forced_groups = (
-            None if steps_per_epoch is None
-            else max(1, -(-steps_per_epoch // spc))
-        )
-
-        def _zero_batch() -> Batch:
-            from glint_word2vec_tpu.corpus.batching import context_width
-
-            B, C = batcher.batch_size, context_width(batcher.window)
-            return Batch(
-                centers=np.zeros(B, np.int32),
-                contexts=np.zeros((B, C), np.int32),
-                mask=np.zeros((B, C), np.float32),
-                words_done=batcher.words_done,
-            )
-
-        def _sched_alpha(idx_in_epoch: int, epoch: int) -> tuple:
-            # Deterministic global LR schedule for multi-host lockstep:
-            # every process must compute the identical alpha without
-            # exchanging its (slightly different) local word counts. The
-            # epoch's words are attributed pro-rata over its agreed step
-            # count — the same linear anneal as the reference's global
-            # wordCount-driven schedule (mllib:405-413), quantized to steps.
-            frac = min((idx_in_epoch + 1) / steps_per_epoch, 1.0)
-            wd = epoch * twc + frac * twc
-            return (
-                max(p.step_size * (1 - wd / total_words), p.step_size * 1e-4),
-                int(wd),
-            )
-
-        for epoch in range(start_epoch, p.num_iterations):
-            # Double-buffered infeed: batches are produced on a background
-            # thread while the device executes (utils/prefetch.py), then
-            # dispatched ``steps_per_call`` at a time as one on-device scan
-            # (EmbeddingEngine.train_steps) — one host round-trip per group.
-            it = prefetch(batcher.epoch(epoch), depth=2 * spc)
-            g = 0
-            while True:
-                if forced_groups is not None and g >= forced_groups:
-                    if next(it, None) is not None:
-                        raise RuntimeError(
-                            "internal error: local shard produced more "
-                            "batches than the agreed per-epoch step count"
+            if state_path and os.path.exists(state_path):
+                with open(state_path) as f:
+                    state = json.load(f)
+                with obs_run.span(
+                    "checkpoint_restore", ckpt=state.get("ckpt", "ckpt")
+                ):
+                    if "ckpt" in state:
+                        engine.load_tables(
+                            os.path.join(checkpoint_dir, state["ckpt"])
                         )
-                    break
-                group = []
-                with metrics.timing("host"):
-                    while len(group) < spc:
-                        batch = next(it, None)
-                        if batch is None:
-                            break
-                        group.append(batch)
-                pad_only = False
-                if not group:
-                    if forced_groups is None:
+                    else:  # legacy single-file layout
+                        engine.set_tables(
+                            np.load(
+                                os.path.join(checkpoint_dir, "ckpt", "syn0.npy")
+                            ),
+                            np.load(
+                                os.path.join(checkpoint_dir, "ckpt", "syn1.npy")
+                            ),
+                        )
+                start_epoch = state["epochs_completed"]
+                step = state["step"]
+                batcher.words_done = state["words_done"]
+                logger.info(
+                    "resuming after epoch %d (step %d)", start_epoch, step
+                )
+            # Metrics count only THIS invocation's work; on resume the restored
+            # global counter must not inflate throughput numbers.
+            metrics = TrainingMetrics(base_words=batcher.words_done)
+            obs_run.attach_metrics(metrics)
+
+            def save_checkpoint(epochs_completed: int) -> None:
+                # Atomic: the sharded table snapshot lands in a fresh directory
+                # first; state.json (atomic rename) flips to it last, so a crash
+                # mid-write can never yield a state file pointing at mismatched
+                # or partial tables. Older snapshot dirs are pruned after.
+                # Multi-host: every process writes its own table shards
+                # (engine.save), then a barrier ensures all shards are on disk
+                # before process 0 alone flips state.json and prunes — per-host
+                # counters can diverge only by padding, and a lone writer keeps
+                # the flip atomic.
+                ck_name = f"ckpt-{epochs_completed}"
+                with obs_run.span("checkpoint_save", ckpt=ck_name):
+                    engine.save(os.path.join(checkpoint_dir, ck_name))
+                if pc > 1:
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(
+                        f"glint_w2v_ckpt_{epochs_completed}"
+                    )
+                if jax.process_index() == 0:
+                    # words_done feeds the resumed run's metrics base and the
+                    # single-host LR accounting; under the multi-host schedule
+                    # the global pro-rata count is the coherent value (the local
+                    # batcher count is per-shard and would mix units).
+                    wd = (
+                        batcher.words_done
+                        if steps_per_epoch is None
+                        else epochs_completed * vocab.train_words_count
+                    )
+                    _flip_checkpoint_state(
+                        checkpoint_dir, state_path, ck_name,
+                        epochs_completed=epochs_completed, step=step,
+                        words_done=wd,
+                    )
+                if pc > 1:
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(
+                        f"glint_w2v_ckpt_done_{epochs_completed}"
+                    )
+
+            spc = p.steps_per_call
+            twc = vocab.train_words_count
+            # Multi-host: steps_per_epoch fixes the dispatch count; groups are
+            # the scan-length quantized version of it.
+            forced_groups = (
+                None if steps_per_epoch is None
+                else max(1, -(-steps_per_epoch // spc))
+            )
+
+            def _zero_batch() -> Batch:
+                from glint_word2vec_tpu.corpus.batching import context_width
+
+                B, C = batcher.batch_size, context_width(batcher.window)
+                return Batch(
+                    centers=np.zeros(B, np.int32),
+                    contexts=np.zeros((B, C), np.int32),
+                    mask=np.zeros((B, C), np.float32),
+                    words_done=batcher.words_done,
+                )
+
+            def _sched_alpha(idx_in_epoch: int, epoch: int) -> tuple:
+                # Deterministic global LR schedule for multi-host lockstep:
+                # every process must compute the identical alpha without
+                # exchanging its (slightly different) local word counts. The
+                # epoch's words are attributed pro-rata over its agreed step
+                # count — the same linear anneal as the reference's global
+                # wordCount-driven schedule (mllib:405-413), quantized to steps.
+                frac = min((idx_in_epoch + 1) / steps_per_epoch, 1.0)
+                wd = epoch * twc + frac * twc
+                return (
+                    max(p.step_size * (1 - wd / total_words), p.step_size * 1e-4),
+                    int(wd),
+                )
+
+            for epoch in range(start_epoch, p.num_iterations):
+                obs_run.update(epoch=epoch)
+                # Double-buffered infeed: batches are produced on a
+                # background thread while the device executes
+                # (utils/prefetch.py), then dispatched ``steps_per_call``
+                # at a time as one on-device scan
+                # (EmbeddingEngine.train_steps) — one host round-trip per
+                # group.
+                it = prefetch(batcher.epoch(epoch), depth=2 * spc)
+                g = 0
+                while True:
+                    if forced_groups is not None and g >= forced_groups:
+                        if next(it, None) is not None:
+                            raise RuntimeError(
+                                "internal error: local shard produced more "
+                                "batches than the agreed per-epoch step count"
+                            )
                         break
-                    # Lockstep padding: this host's shard is exhausted but
-                    # other hosts still have batches — keep dispatching
-                    # zero-mask groups up to the agreed count. Exactly spc
-                    # batches (the scan length every host dispatches) so
-                    # batch stacks, alphas, and PRNG key advancement stay
-                    # in lockstep; excluded from metrics (n_real=0) so
-                    # no-op steps don't deflate loss curves.
-                    group = [_zero_batch()] * spc
-                    pad_only = True
-                n_real = 0 if pad_only else len(group)
-                if not pad_only and n_real < spc:
-                    # Pad the epoch-tail group to the full scan length so
-                    # the jitted scan never sees a second K (XLA compiles
-                    # are expensive). Zero-mask batches update nothing.
-                    proto = group[0]
-                    pad = Batch(
-                        centers=np.zeros_like(proto.centers),
-                        contexts=np.zeros_like(proto.contexts),
-                        mask=np.zeros_like(proto.mask),
-                        words_done=group[-1].words_done,
-                    )
-                    group.extend([pad] * (spc - n_real))
-                if steps_per_epoch is None:
-                    alphas = [
-                        max(
-                            p.step_size * (1 - b.words_done / total_words),
-                            p.step_size * 1e-4,
+                    group = []
+                    with metrics.timing("host"), obs_run.span(
+                        "host_batch", epoch=epoch, group=g
+                    ):
+                        while len(group) < spc:
+                            batch = next(it, None)
+                            if batch is None:
+                                break
+                            group.append(batch)
+                    pad_only = False
+                    if not group:
+                        if forced_groups is None:
+                            break
+                        # Lockstep padding: this host's shard is exhausted
+                        # but other hosts still have batches — keep
+                        # dispatching zero-mask groups up to the agreed
+                        # count. Exactly spc batches (the scan length every
+                        # host dispatches) so batch stacks, alphas, and
+                        # PRNG key advancement stay in lockstep; excluded
+                        # from metrics (n_real=0) so no-op steps don't
+                        # deflate loss curves.
+                        group = [_zero_batch()] * spc
+                        pad_only = True
+                    n_real = 0 if pad_only else len(group)
+                    if not pad_only and n_real < spc:
+                        # Pad the epoch-tail group to the full scan length
+                        # so the jitted scan never sees a second K (XLA
+                        # compiles are expensive). Zero-mask batches update
+                        # nothing.
+                        proto = group[0]
+                        pad = Batch(
+                            centers=np.zeros_like(proto.centers),
+                            contexts=np.zeros_like(proto.contexts),
+                            mask=np.zeros_like(proto.mask),
+                            words_done=group[-1].words_done,
                         )
-                        for b in group
-                    ]
-                    wds = [b.words_done for b in group]
-                else:
-                    sched = [
-                        _sched_alpha(g * spc + j, epoch) for j in range(spc)
-                    ]
-                    alphas = [a for a, _ in sched]
-                    wds = [w for _, w in sched]
-                # The whole device interaction counts as "step" time:
-                # the dispatch AND the loss reads (record_step syncs on
-                # the device every log_every steps — with async dispatch
-                # that wait IS the device time, and leaving it outside
-                # both buckets made host_frac meaningless).
-                with metrics.timing("step"):
-                    losses = self._train_batches(
-                        engine, group, base_key, step, np.asarray(alphas, np.float32)
-                    )
-                    for i in range(n_real):
-                        step += 1
-                        metrics.record_step(
-                            wds[i], loss=losses[i], alpha=alphas[i]
+                        group.extend([pad] * (spc - n_real))
+                    if steps_per_epoch is None:
+                        alphas = [
+                            max(
+                                p.step_size * (1 - b.words_done / total_words),
+                                p.step_size * 1e-4,
+                            )
+                            for b in group
+                        ]
+                        wds = [b.words_done for b in group]
+                    else:
+                        sched = [
+                            _sched_alpha(g * spc + j, epoch)
+                            for j in range(spc)
+                        ]
+                        alphas = [a for a, _ in sched]
+                        wds = [w for _, w in sched]
+                    # The whole device interaction counts as "step" time:
+                    # the dispatch AND the loss reads (record_step syncs on
+                    # the device every log_every steps — with async
+                    # dispatch that wait IS the device time, and leaving it
+                    # outside both buckets made host_frac meaningless).
+                    with metrics.timing("step"), obs_run.span(
+                        "device_steps", step0=step, n=n_real
+                    ):
+                        losses = self._train_batches(
+                            engine, group, base_key, step,
+                            np.asarray(alphas, np.float32),
                         )
-                step += len(group) - n_real  # padded steps consumed keys too
-                g += 1
-            stopping = (
-                stop_after_epochs is not None
-                and (epoch + 1 - start_epoch) >= stop_after_epochs
-            )
-            if state_path and (
-                stopping or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
-            ):
-                save_checkpoint(epoch + 1)
-            if stopping:
-                logger.info("stopping early after epoch %d", epoch + 1)
-                break
+                        for i in range(n_real):
+                            step += 1
+                            metrics.record_step(
+                                wds[i], loss=losses[i], alpha=alphas[i]
+                            )
+                        # Inside the step bucket: the canary's periodic
+                        # loss sync waits on the device, and device waits
+                        # outside both buckets would skew host_frac.
+                        obs_run.observe_losses(step - n_real, losses, n_real)
+                    if n_real:
+                        obs_run.update(
+                            step=step, words_done=int(wds[n_real - 1]),
+                            alpha=float(alphas[n_real - 1]),
+                        )
+                    step += len(group) - n_real  # padded steps used keys too
+                    g += 1
+                stopping = (
+                    stop_after_epochs is not None
+                    and (epoch + 1 - start_epoch) >= stop_after_epochs
+                )
+                if state_path and (
+                    stopping
+                    or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
+                ):
+                    save_checkpoint(epoch + 1)
+                if stopping:
+                    logger.info("stopping early after epoch %d", epoch + 1)
+                    break
+        except TrainingDiverged:
+            _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
+            raise
+        except BaseException:
+            obs_run.close(failed=True)
+            raise
+        finally:
+            obs_run.close()
         logger.info("training done: %s", metrics.summary())
         model = self._make_model(vocab, engine)
         model.training_metrics = {**metrics.summary(), "pipeline": "host"}

@@ -1,0 +1,73 @@
+"""Divergence canary: rolling-loss NaN/Inf and explosion detection.
+
+The reference's only divergence signal is the last positive dot product
+printed in its periodic log line, left for a human to eyeball
+(mllib:399-413). On a multi-hour TPU fit that is operationally useless:
+a run that NaNs at hour two burns the remaining budget training garbage.
+This is the TPU-native replacement — a rolling window over the per-step
+SGNS loss with two trip conditions:
+
+- **non-finite**: any NaN/Inf loss (the unambiguous failure);
+- **explosion**: a loss more than ``factor`` times the window median
+  once the window holds ``min_history`` healthy samples.
+
+The canary itself only classifies; the caller (obs.ObsRun) decides
+warn-vs-abort and owns the abort side effects (final checkpoint,
+event-log flush, raising :class:`TrainingDiverged`).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised by the abort-mode canary after the event log has been
+    flushed; the fit loop writes the final ``ckpt-diverged`` table
+    snapshot on the way out so the run is post-mortemable."""
+
+
+class DivergenceCanary:
+    """Rolling loss window; ``check`` returns None while healthy, else a
+    one-line human-readable trip reason.
+
+    A tripped (exploded or non-finite) sample is kept OUT of the window,
+    so a sustained explosion keeps tripping instead of normalizing
+    itself into the baseline median.
+    """
+
+    def __init__(self, window: int = 64, factor: float = 10.0,
+                 min_history: int = 8):
+        self.window: deque = deque(maxlen=max(2, int(window)))
+        self.factor = float(factor)
+        self.min_history = max(2, int(min_history))
+        self.trips = 0
+        self.last_reason: Optional[str] = None
+
+    def _median(self) -> float:
+        vals = sorted(self.window)
+        mid = len(vals) // 2
+        if len(vals) % 2:
+            return vals[mid]
+        return 0.5 * (vals[mid - 1] + vals[mid])
+
+    def check(self, step: int, loss: float) -> Optional[str]:
+        loss = float(loss)
+        if not math.isfinite(loss):
+            self.trips += 1
+            self.last_reason = f"non-finite loss {loss} at step {step}"
+            return self.last_reason
+        if len(self.window) >= self.min_history:
+            med = self._median()
+            if med > 0 and loss > self.factor * med:
+                self.trips += 1
+                self.last_reason = (
+                    f"loss {loss:.4g} at step {step} is {loss / med:.1f}x "
+                    f"the rolling median {med:.4g} "
+                    f"(threshold {self.factor:g}x)"
+                )
+                return self.last_reason
+        self.window.append(loss)
+        return None

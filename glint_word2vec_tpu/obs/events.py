@@ -1,0 +1,236 @@
+"""Low-overhead span/event recording for run-wide observability.
+
+The reference's entire training telemetry is one log line every 10k words
+(mllib:399-413; SURVEY.md §5 "tracing: none"). This is the structured
+replacement: instrumentation sites (the fit loops' phases, engine table
+mutations, query-shape compiles) record spans and instant events into a
+thread-safe bounded ring with an optional JSONL sink, and the ring
+re-exports as a Chrome-trace (``chrome://tracing`` / Perfetto) JSON so
+host spans can be eyeballed against the device xplane traces
+``scripts/trace_summarize.py`` parses.
+
+Two usage layers:
+
+- ``EventRecorder`` — the recorder object a run owns (obs.ObsRun wires
+  one per instrumented fit).
+- Module-level ``emit(name, **args)`` / ``span(name, **args)`` — the
+  process-wide hooks instrumentation sites call unconditionally. With no
+  recorder installed they cost one global read (and ``span`` returns a
+  shared no-op context manager), so the disabled path stays off the fit
+  hot loop's profile.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class _NullSpan:
+    """Shared no-op context manager returned when recording is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_rec", "_name", "_args", "_t0")
+
+    def __init__(self, rec: "EventRecorder", name: str, args: dict):
+        self._rec = rec
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._rec._record(self._name, "X", self._t0, t1 - self._t0, self._args)
+        return False
+
+
+class EventRecorder:
+    """Thread-safe span/event log: the newest ``capacity`` events in a
+    bounded ring (overflow counted in ``dropped``, never unbounded host
+    memory) plus an optional JSONL sink that receives EVERY event.
+
+    Event timestamps (``ts``, microseconds) run on a process-local
+    monotonic clock anchored at recorder construction; ``wall_t0`` maps
+    them back to the epoch for correlation with device traces. Span
+    events use the Chrome-trace complete form (``ph: "X"`` with ``dur``),
+    instants ``ph: "i"`` — each JSONL line IS a valid traceEvents entry,
+    and :meth:`chrome_trace` wraps the ring into a full document.
+    """
+
+    def __init__(self, capacity: int = 65536,
+                 jsonl_path: Optional[str] = None):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self.recorded = 0
+        self.dropped = 0
+        self.jsonl_path = jsonl_path
+        self.wall_t0 = time.time()
+        self._t0 = time.perf_counter()
+        self._sink = open(jsonl_path, "w") if jsonl_path else None
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def _record(self, name: str, ph: str, t0: float, dur: float,
+                args: dict) -> None:
+        ev = {
+            "name": name,
+            "ph": ph,
+            "ts": round((t0 - self._t0) * 1e6, 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if ph == "X":
+            ev["dur"] = round(dur * 1e6, 1)
+        else:
+            ev["s"] = "t"  # instant scope: this thread
+        if args:
+            ev["args"] = args
+        with self._mu:
+            self.recorded += 1
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(ev) + "\n")
+                except OSError as e:
+                    # Observability must never take down the run it
+                    # monitors: a dying sink (disk full, quota) degrades
+                    # to ring-only recording.
+                    self._drop_sink_locked(e)
+
+    def event(self, name: str, **args) -> None:
+        """Record one instant event."""
+        self._record(name, "i", time.perf_counter(), 0.0, args)
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager recording one complete ("X") span on exit."""
+        return _Span(self, name, args)
+
+    def events(self) -> list:
+        """Snapshot of the ring (oldest first)."""
+        with self._mu:
+            return list(self._ring)
+
+    def counts(self) -> dict:
+        with self._mu:
+            return {
+                "recorded": self.recorded,
+                "dropped": self.dropped,
+                "capacity": self._ring.maxlen,
+            }
+
+    # -- export --------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """The ring as a ``chrome://tracing`` / Perfetto JSON document."""
+        return {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_t0": self.wall_t0,
+                "dropped": self.dropped,
+            },
+        }
+
+    def export_chrome_trace(self, path: str) -> None:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(path, self.chrome_trace())
+
+    def _drop_sink_locked(self, err) -> None:
+        """Disable the JSONL sink after an I/O failure; caller holds
+        the lock. Recording continues ring-only."""
+        logger.warning(
+            "event-log sink %s failed, continuing ring-only: %s",
+            self.jsonl_path, err,
+        )
+        sink, self._sink = self._sink, None
+        try:
+            sink.close()
+        except OSError:
+            pass
+
+    def flush(self) -> None:
+        with self._mu:
+            if self._sink is not None:
+                try:
+                    self._sink.flush()
+                except OSError as e:
+                    self._drop_sink_locked(e)
+
+    def close(self) -> None:
+        with self._mu:
+            if self._sink is not None:
+                try:
+                    self._sink.flush()
+                    self._sink.close()
+                except OSError as e:
+                    logger.warning(
+                        "event-log sink %s failed at close: %s",
+                        self.jsonl_path, e,
+                    )
+                self._sink = None
+
+
+# ----------------------------------------------------------------------
+# Process-wide current recorder: what engine-level instrumentation sites
+# emit through without threading a recorder handle down every call path.
+# ----------------------------------------------------------------------
+
+_current: Optional[EventRecorder] = None
+
+
+def set_recorder(rec: Optional[EventRecorder]) -> Optional[EventRecorder]:
+    """Install the process-wide recorder (None disables). Returns ``rec``.
+    One instrumented fit at a time is the supported shape; a second
+    concurrent fit in the same process shares (or displaces) the
+    recorder rather than corrupting anything."""
+    global _current
+    _current = rec
+    return rec
+
+
+def get_recorder() -> Optional[EventRecorder]:
+    return _current
+
+
+def emit(name: str, **args) -> None:
+    """Instant event on the current recorder; no-op when recording is off."""
+    rec = _current
+    if rec is not None:
+        rec.event(name, **args)
+
+
+def span(name: str, **args):
+    """Span on the current recorder; the shared no-op context manager
+    when recording is off (the disabled path must cost ~nothing on the
+    fit hot loop)."""
+    rec = _current
+    if rec is None:
+        return NULL_SPAN
+    return rec.span(name, **args)

@@ -1,0 +1,249 @@
+"""Prometheus text exposition (format version 0.0.4) for the snapshots
+``utils/metrics.py`` and ``obs/heartbeat.py`` already compute.
+
+Pure functions dict -> text so both HTTP layers (the serving
+``ModelServer`` and the training heartbeat) render
+``/metrics?format=prometheus`` from the exact same snapshot their JSON
+endpoint serves — no second bookkeeping path to drift. Also exports
+:func:`lint_prometheus_text`, the text-format validator the tests and
+the CI obs smoke run over every rendered exposition.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+
+def _esc(v) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _num(v) -> str:
+    if v is None:
+        return "NaN"
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class _Prom:
+    """Tiny exposition writer: HELP/TYPE heads + sample lines."""
+
+    def __init__(self):
+        self.lines = []
+
+    def head(self, name: str, mtype: str, help_: str) -> None:
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, labels, value) -> None:
+        if labels:
+            lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+            self.lines.append(f"{name}{{{lab}}} {_num(value)}")
+        else:
+            self.lines.append(f"{name} {_num(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+# ----------------------------------------------------------------------
+# Training heartbeat exposition (obs/heartbeat.TrainingStatus.snapshot)
+# ----------------------------------------------------------------------
+
+
+def training_to_prometheus(snap: dict) -> str:
+    """Render a TrainingStatus snapshot as scrape-ready text."""
+    p = _Prom()
+    p.head("glint_training_info", "gauge",
+           "Run metadata carried as labels; value is always 1.")
+    p.sample("glint_training_info",
+             {"pipeline": snap.get("pipeline", ""),
+              "state": snap.get("state", "")}, 1)
+    gauges = [
+        ("glint_training_epoch", "epoch", "Current epoch (0-based)."),
+        ("glint_training_total_epochs", "total_epochs",
+         "Configured epoch count."),
+        ("glint_training_words_per_sec", "words_per_sec_rolling",
+         "Rolling trained-words/sec over the recent update window."),
+        ("glint_training_alpha", "alpha", "Current annealed learning rate."),
+        ("glint_training_last_loss", "last_loss",
+         "Most recently synced per-step loss (NaN until first sync)."),
+        ("glint_training_host_frac", "host_frac",
+         "Fraction of accounted wall time spent in host batching."),
+        ("glint_training_uptime_seconds", "uptime_seconds",
+         "Seconds since the fit's observability run started."),
+        ("glint_training_table_version", "table_version",
+         "Engine table-mutation counter (serving caches validate on it)."),
+        ("glint_training_diverged", None,
+         "1 when the divergence canary aborted the run, else 0."),
+    ]
+    for name, key, help_ in gauges:
+        p.head(name, "gauge", help_)
+        if key is None:
+            p.sample(name, None, 1 if snap.get("state") == "diverged" else 0)
+        else:
+            p.sample(name, None, snap.get(key))
+    counters = [
+        ("glint_training_steps_total", "step", "Optimizer steps completed."),
+        ("glint_training_words_done_total", "words_done",
+         "Trained words (pre-subsampling accounting)."),
+        ("glint_training_query_compiles_total", "query_compiles",
+         "Query-op shapes jit-compiled by the engine."),
+    ]
+    for name, key, help_ in counters:
+        p.head(name, "counter", help_)
+        p.sample(name, None, snap.get(key, 0))
+    canary = snap.get("canary") or {}
+    p.head("glint_canary_trips_total", "counter",
+           "Divergence-canary trips this run.")
+    p.sample("glint_canary_trips_total", None, canary.get("trips", 0))
+    events = snap.get("events") or {}
+    if events:
+        p.head("glint_obs_events_recorded_total", "counter",
+               "Span/instant events recorded by the event ring.")
+        p.sample("glint_obs_events_recorded_total", None,
+                 events.get("recorded", 0))
+        p.head("glint_obs_events_dropped_total", "counter",
+               "Events evicted from the bounded ring.")
+        p.sample("glint_obs_events_dropped_total", None,
+                 events.get("dropped", 0))
+    mem = snap.get("device_memory") or {}
+    if mem:
+        p.head("glint_device_memory_bytes", "gauge",
+               "Per-device memory stats where the backend reports them.")
+        for dev, stats in sorted(mem.items()):
+            for stat, val in sorted(stats.items()):
+                p.sample("glint_device_memory_bytes",
+                         {"device": dev, "stat": stat}, val)
+    return p.text()
+
+
+# ----------------------------------------------------------------------
+# Serving exposition (utils/metrics.ServingMetrics.snapshot)
+# ----------------------------------------------------------------------
+
+
+def serving_to_prometheus(snap: dict) -> str:
+    """Render a ServingMetrics snapshot as scrape-ready text: request and
+    error counters per endpoint, a latency summary (the histogram's
+    p50/p95/p99), the coalesced-batch-size histogram, cache counters,
+    and the compile accounting the PR-2 zero-compile contract watches."""
+    p = _Prom()
+    endpoints = snap.get("endpoints", {})
+    p.head("glint_serving_requests_total", "counter",
+           "Requests observed per endpoint path.")
+    for path, ep in endpoints.items():
+        p.sample("glint_serving_requests_total", {"path": path}, ep["count"])
+    p.head("glint_serving_request_errors_total", "counter",
+           "Responses with status >= 400 per endpoint path.")
+    for path, ep in endpoints.items():
+        p.sample("glint_serving_request_errors_total", {"path": path},
+                 ep["errors"])
+    p.head("glint_serving_request_latency_seconds", "summary",
+           "Per-endpoint request latency quantiles.")
+    for path, ep in endpoints.items():
+        for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                       ("0.99", "p99_ms")):
+            p.sample("glint_serving_request_latency_seconds",
+                     {"path": path, "quantile": q}, ep[key] / 1e3)
+        p.sample("glint_serving_request_latency_seconds_sum",
+                 {"path": path}, ep["mean_ms"] * ep["count"] / 1e3)
+        p.sample("glint_serving_request_latency_seconds_count",
+                 {"path": path}, ep["count"])
+    sizes = {int(k): int(v)
+             for k, v in snap.get("coalesced_batch_sizes", {}).items()}
+    p.head("glint_serving_coalesced_batch_size", "histogram",
+           "Queries per coalesced device dispatch.")
+    cum, total = 0, 0
+    for size in sorted(sizes):
+        cum += sizes[size]
+        total += size * sizes[size]
+        p.sample("glint_serving_coalesced_batch_size_bucket",
+                 {"le": str(size)}, cum)
+    p.sample("glint_serving_coalesced_batch_size_bucket", {"le": "+Inf"}, cum)
+    p.sample("glint_serving_coalesced_batch_size_sum", None, total)
+    p.sample("glint_serving_coalesced_batch_size_count", None, cum)
+    cache = snap.get("synonym_cache", {})
+    p.head("glint_serving_cache_hits_total", "counter",
+           "Synonym result-cache hits.")
+    p.sample("glint_serving_cache_hits_total", None, cache.get("hits", 0))
+    p.head("glint_serving_cache_misses_total", "counter",
+           "Synonym result-cache misses.")
+    p.sample("glint_serving_cache_misses_total", None, cache.get("misses", 0))
+    compiles = snap.get("compiles", {})
+    p.head("glint_serving_compiles_total", "counter",
+           "Query-op shapes jit-compiled since engine construction.")
+    p.sample("glint_serving_compiles_total", None, compiles.get("total", 0))
+    p.head("glint_serving_post_warmup_compiles", "gauge",
+           "Compiles past serving warmup (the zero-compile contract).")
+    p.sample("glint_serving_post_warmup_compiles", None,
+             compiles.get("post_warmup", 0))
+    return p.text()
+
+
+# ----------------------------------------------------------------------
+# Text-format lint
+# ----------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(\{{{_LABEL}(?:,{_LABEL})*\}})?"
+    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)$"
+)
+_COMMENT_RE = re.compile(rf"^# (HELP|TYPE) ({_NAME})( .*)?$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def lint_prometheus_text(text: str) -> None:
+    """Validate the subset of the 0.0.4 text format the renderers emit.
+
+    Raises ``ValueError`` naming the first offending line; returns None
+    on clean input. Checks: trailing newline, HELP/TYPE comment grammar,
+    valid metric types, no duplicate TYPE, TYPE declared before its
+    samples, and full sample-line grammar (metric/label name charset,
+    escaped label values, parseable value).
+    """
+    if not text.endswith("\n"):
+        raise ValueError("exposition must end with a newline")
+    typed: dict = {}
+    sampled: set = set()
+    for i, line in enumerate(text.split("\n")[:-1], 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            m = _COMMENT_RE.match(line)
+            if not m:
+                raise ValueError(f"line {i}: malformed comment: {line!r}")
+            if m.group(1) == "TYPE":
+                name, t = m.group(2), (m.group(3) or "").strip()
+                if t not in _TYPES:
+                    raise ValueError(
+                        f"line {i}: invalid metric type {t!r} for {name}"
+                    )
+                if name in typed:
+                    raise ValueError(f"line {i}: duplicate TYPE for {name}")
+                if name in sampled:
+                    raise ValueError(
+                        f"line {i}: TYPE for {name} after its samples"
+                    )
+                typed[name] = t
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: malformed sample line: {line!r}")
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        sampled.add(m.group(1))
+        sampled.add(base)

@@ -59,6 +59,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from glint_word2vec_tpu.corpus.alias import build_unigram_alias
+from glint_word2vec_tpu.obs import events as obs_events
 from glint_word2vec_tpu.ops import sgns
 from glint_word2vec_tpu.utils import next_pow2
 from glint_word2vec_tpu.ops.sampling import (
@@ -1025,8 +1026,7 @@ class EmbeddingEngine:
             self.syn0, self.syn1, self._prob, self._alias,
             cg, gm, cx, mk, key, jnp.float32(alpha),
         )
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("train_step")
         return loss
 
     def train_steps(
@@ -1096,8 +1096,7 @@ class EmbeddingEngine:
             base_key, jnp.uint32(step0),
             jnp.asarray(alphas, dtype=jnp.float32),
         )
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("train_steps")
         return losses
 
     # ------------------------------------------------------------------
@@ -1229,13 +1228,23 @@ class EmbeddingEngine:
             jnp.int32(n_valid), jnp.int32(start_position), base_key,
             jnp.uint32(step0), jnp.asarray(alphas, dtype=jnp.float32),
         )
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("train_steps_corpus")
         return losses
 
     # ------------------------------------------------------------------
     # Serving ops (the BigWord2VecMatrix query surface)
     # ------------------------------------------------------------------
+
+    def _tick_tables(self, reason: str) -> None:
+        """One table mutation: invalidate the norms cache, tick
+        ``table_version`` (the token serving-layer caches validate
+        against), and record the engine-level event (a no-op global read
+        when no recorder is installed)."""
+        self._norms_cache = None
+        self.table_version += 1
+        obs_events.emit(
+            "table_mutation", reason=reason, version=self.table_version
+        )
 
     def _count_query_shape(self, *key) -> None:
         """Record one query-op dispatch shape; a first-seen shape is one
@@ -1244,6 +1253,10 @@ class EmbeddingEngine:
         if key not in self._query_shapes:
             self._query_shapes.add(key)
             self.query_compiles += 1
+            obs_events.emit(
+                "query_compile", op=str(key[0]), shape=list(key[1:]),
+                total=self.query_compiles,
+            )
 
     def _k_bucket(self, k: int) -> int:
         """Round a top-k request up to its compile bucket (see
@@ -1294,8 +1307,7 @@ class EmbeddingEngine:
         self.syn0 = self._write_rows_fn(
             self.syn0, rows, jnp.int32(start_row)
         )
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("write_rows")
 
     def norms(self) -> jax.Array:
         """Per-row Euclidean norms of syn0, computed shard-local (Glint
@@ -1416,22 +1428,26 @@ class EmbeddingEngine:
         requests, so a warmed bucket can never re-compile. Returns the
         number of shapes this call compiled (0 = already warm)."""
         before = self.query_compiles
-        d = self.dim
-        ks = sorted({self._k_bucket(int(k)) for k in k_buckets})
-        for k in ks:
-            self.top_k_cosine(np.zeros(d, np.float32), k)
-        for q in sorted({next_pow2(int(q)) for q in q_buckets}):
-            self.pull(np.zeros(q, np.int32))
-        for q in sorted({self._q_bucket(int(q)) for q in q_buckets}):
-            zq = np.zeros((q, d), np.float32)
+        with obs_events.span("engine_warmup"):
+            d = self.dim
+            ks = sorted({self._k_bucket(int(k)) for k in k_buckets})
             for k in ks:
-                self.top_k_cosine_batch(zq, k)
-        for s in sorted({next_pow2(int(s)) for s in sentence_rows}):
-            for L in sorted({next_pow2(int(L)) for L in sentence_lens}):
-                self.pull_average(
-                    np.zeros((s, L), np.int32), np.zeros((s, L), np.float32)
-                )
-        return self.query_compiles - before
+                self.top_k_cosine(np.zeros(d, np.float32), k)
+            for q in sorted({next_pow2(int(q)) for q in q_buckets}):
+                self.pull(np.zeros(q, np.int32))
+            for q in sorted({self._q_bucket(int(q)) for q in q_buckets}):
+                zq = np.zeros((q, d), np.float32)
+                for k in ks:
+                    self.top_k_cosine_batch(zq, k)
+            for s in sorted({next_pow2(int(s)) for s in sentence_rows}):
+                for L in sorted({next_pow2(int(L)) for L in sentence_lens}):
+                    self.pull_average(
+                        np.zeros((s, L), np.int32),
+                        np.zeros((s, L), np.float32),
+                    )
+        n = self.query_compiles - before
+        obs_events.emit("warmup_done", shapes_compiled=n)
+        return n
 
     # ------------------------------------------------------------------
     # Persistence / lifecycle
@@ -1633,8 +1649,7 @@ class EmbeddingEngine:
                     (self.padded_vocab, self.padded_dim), tsh, assemble
                 ),
             )
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("load_tables")
 
     def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:
         """Install host table values (unpadded, all num_rows rows),
@@ -1652,8 +1667,7 @@ class EmbeddingEngine:
         full1 = np.pad(syn1, pad).astype(np.float32)
         self.syn0 = jax.device_put(jnp.asarray(full0, dtype=self._dtype), tsh)
         self.syn1 = jax.device_put(jnp.asarray(full1, dtype=self._dtype), tsh)
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("set_tables")
 
     def destroy(self) -> None:
         """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
@@ -1673,8 +1687,7 @@ class EmbeddingEngine:
         self._corpus = None
         self._corpus_compacted = None
         self._keep_prob = None
-        self._norms_cache = None
-        self.table_version += 1
+        self._tick_tables("destroy")
 
     @property
     def cols(self) -> int:

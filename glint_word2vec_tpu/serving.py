@@ -16,6 +16,9 @@ Endpoints (JSON in/out, stdlib-only server):
                                "compiles": n, "post_warmup_compiles": n, ...}
   GET  /metrics            per-endpoint latency histograms (p50/p95/p99),
                            coalesced-batch-size distribution, compile counts
+  GET  /metrics?format=prometheus
+                           the same snapshot as Prometheus text exposition
+                           (scrape-ready; JSON stays the default)
   POST /synonyms           {"word": w, "num": k}
   POST /synonyms_vector    {"vector": [...], "num": k}
   POST /analogy            {"positive": [...], "negative": [...], "num": k}
@@ -44,9 +47,11 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from glint_word2vec_tpu.obs.prometheus import serving_to_prometheus
 from glint_word2vec_tpu.utils import next_pow2
 from glint_word2vec_tpu.utils.metrics import ServingMetrics
 
@@ -382,11 +387,27 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self._status = code
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 t0 = time.perf_counter()
                 self._status = 500
+                # Parsed path: routing and metric keys must not vary with
+                # the query string (?format=... would otherwise mint a
+                # fresh latency histogram per variant).
+                url = urlparse(self.path)
                 try:
-                    if self.path == "/healthz":
+                    if url.path == "/healthz":
                         m = server.model
                         compiles = server._query_compiles()
                         self._send(
@@ -402,36 +423,43 @@ class ModelServer:
                                 - server.metrics.warmup_compiles,
                             },
                         )
-                    elif self.path == "/metrics":
-                        self._send(
-                            200,
-                            server.metrics.snapshot(server._query_compiles()),
+                    elif url.path == "/metrics":
+                        snap = server.metrics.snapshot(
+                            server._query_compiles()
                         )
+                        fmt = parse_qs(url.query).get("format", ["json"])[0]
+                        if fmt == "prometheus":
+                            self._send_text(200, serving_to_prometheus(snap))
+                        else:
+                            self._send(200, snap)
                     else:
-                        self._send(404, {"error": f"no route {self.path}"})
+                        self._send(404, {"error": f"no route {url.path}"})
                 finally:
                     server.metrics.observe(
-                        self.path, time.perf_counter() - t0, self._status
+                        url.path, time.perf_counter() - t0, self._status
                     )
 
             def do_POST(self):
                 t0 = time.perf_counter()
                 self._status = 500
+                # Same parsed-path rule as do_GET: routing and metric
+                # keys must not vary with the query string.
+                path = urlparse(self.path).path
                 try:
-                    self._handle_post()
+                    self._handle_post(path)
                 finally:
                     server.metrics.observe(
-                        self.path, time.perf_counter() - t0, self._status
+                        path, time.perf_counter() - t0, self._status
                     )
 
-            def _handle_post(self):
+            def _handle_post(self, path):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
                 except (ValueError, json.JSONDecodeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
                 try:
-                    if self.path == "/synonyms":
+                    if path == "/synonyms":
                         out = [
                             [w, float(s)]
                             for w, s in server._coalescer.query(
@@ -439,7 +467,7 @@ class ModelServer:
                                 num=int(req.get("num", 10)),
                             )
                         ]
-                    elif self.path == "/synonyms_vector":
+                    elif path == "/synonyms_vector":
                         out = [
                             [w, float(s)]
                             for w, s in server._coalescer.query(
@@ -449,7 +477,7 @@ class ModelServer:
                         ]
                     else:
                         with server._lock:
-                            out = server._dispatch(self.path, req)
+                            out = server._dispatch(path, req)
                 except KeyError as e:
                     return self._send(
                         404, {"error": e.args[0] if e.args else str(e)}
@@ -457,9 +485,9 @@ class ModelServer:
                 except ValueError as e:
                     return self._send(400, {"error": str(e)})
                 if out is None:
-                    return self._send(404, {"error": f"no route {self.path}"})
+                    return self._send(404, {"error": f"no route {path}"})
                 self._send(200, out)
-                if self.path == "/shutdown":
+                if path == "/shutdown":
                     threading.Thread(target=server.stop, daemon=True).start()
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

@@ -1,5 +1,19 @@
 """Utilities: parameter validation, logging/metrics, checkpointing."""
 
+import json as _json
+import os as _os
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Write JSON via temp file + ``os.replace`` so a crash mid-write can
+    never leave a truncated document behind (readers either see the old
+    file or the complete new one). Shared by the metrics dump, the obs
+    status-file mirror, and the Chrome-trace export."""
+    tmp = f"{path}.tmp.{_os.getpid()}"
+    with open(tmp, "w") as f:
+        _json.dump(obj, f)
+    _os.replace(tmp, path)
+
 
 def next_pow2(n: int) -> int:
     """Smallest power of two >= n (1 for n <= 1). The shape-bucket

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import bisect
 import contextlib
-import json
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -42,10 +42,17 @@ class TrainingMetrics:
     _t_window: float = field(default_factory=time.time)
     _words_window: int = -1  # sentinel: initialized on first record_step
     history: List[dict] = field(default_factory=list)
+    #: Bound on retained history entries: one dict lands every
+    #: ``log_every`` steps, and unbounded it leaks host memory into the
+    #: final dump() on long production runs. Oldest entries drop first;
+    #: ``history_dropped`` counts them so the dump is honest about gaps.
+    history_max: int = 4096
+    history_dropped: int = 0
 
     def __post_init__(self) -> None:
         self.words_done = self.base_words
         self._words_window = self.base_words
+        self.history = deque(self.history, maxlen=max(1, self.history_max))
 
     def record_step(self, words_done: int, loss=None, alpha=None) -> None:
         self.steps += 1
@@ -70,6 +77,8 @@ class TrainingMetrics:
                     self.host_time / max(self.host_time + self.step_time, 1e-9), 3
                 ),
             }
+            if len(self.history) == self.history.maxlen:
+                self.history_dropped += 1
             self.history.append(entry)
             logger.info(
                 "step %d: %.0f words/s alpha=%s loss=%s host_frac=%s",
@@ -119,8 +128,15 @@ class TrainingMetrics:
         }
 
     def dump(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump({"summary": self.summary(), "history": self.history}, f)
+        """Atomic (temp + ``os.replace``): a crash mid-write can never
+        leave a truncated JSON that poisons downstream tooling."""
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(path, {
+            "summary": self.summary(),
+            "history": list(self.history),
+            "history_dropped": self.history_dropped,
+        })
 
 
 class LatencyHistogram:

@@ -9,8 +9,13 @@ the xplane protobuf directly (tensorflow.tsl.profiler.protobuf) and
 aggregates device-plane event durations by HLO class.
 
 Usage: python scripts/trace_summarize.py --trace DIR [--out FILE]
-Writes one JSON doc: per-device-plane total busy time and the per-class
-µs + share table, classified from the op/fusion names XLA emits.
+                                         [--host-spans EVENTS.jsonl]
+Writes one JSON doc (``schema_version`` stamped): per-device-plane total
+busy time and the per-class µs + share table, classified from the
+op/fusion names XLA emits. ``--host-spans`` merges the obs span event
+log (the JSONL the fit writes with ``--event-log``) as a per-span-name
+host-side table, so host phases (host batching, device dispatch windows,
+compaction, checkpoints) read side by side with the device op classes.
 """
 
 import argparse
@@ -19,8 +24,12 @@ import glob
 import json
 import os
 import re
+import sys
 
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+#: Output-document schema. 2: added schema_version + host_spans.
+SCHEMA_VERSION = 2
 
 
 # Order matters: first match wins. Patterns target XLA HLO op names and
@@ -50,14 +59,29 @@ def classify(name: str) -> str:
     return "other"
 
 
-def summarize(trace_dir: str) -> dict:
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    paths = sorted(
+def find_xplane_files(trace_dir: str) -> list:
+    """All .xplane.pb files under ``trace_dir``, sorted. Importable (and
+    tf-free) so the empty-trace error path is checkable before the heavy
+    protobuf import."""
+    return sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                   recursive=True)
     )
-    out = {"trace_dir": trace_dir, "xplane_files": len(paths), "planes": []}
+
+
+def summarize(trace_dir: str, paths=None) -> dict:
+    if paths is None:
+        paths = find_xplane_files(trace_dir)
+    out = {
+        "schema_version": SCHEMA_VERSION,
+        "trace_dir": trace_dir,
+        "xplane_files": len(paths),
+        "planes": [],
+    }
+    if paths:
+        # Deferred: the protobuf stack is only needed once there is
+        # something to parse.
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
     for path in paths:
         xs = xplane_pb2.XSpace()
         with open(path, "rb") as f:
@@ -104,25 +128,105 @@ def summarize(trace_dir: str) -> dict:
     return out
 
 
-def main():
+def _self_span_times(spans) -> collections.Counter:
+    """Exclusive (self) time per span name for ONE thread's spans:
+    nested spans charge their enclosed time to the innermost span only
+    (the flame-graph convention), so a parent like ``device_steps`` is
+    never double-counted with a child like ``subword_expand``.
+    ``spans`` is a list of (ts_us, dur_us, name)."""
+    out = collections.Counter()
+    stack = []  # [name, start, end, child_time]
+
+    def pop():
+        name, start, end, child = stack.pop()
+        total = end - start
+        out[name] += max(total - child, 0.0)
+        if stack:
+            stack[-1][3] += total
+
+    for ts, dur, name in sorted(spans, key=lambda s: (s[0], -s[1])):
+        while stack and stack[-1][2] <= ts:
+            pop()
+        stack.append([name, ts, ts + dur, 0.0])
+    while stack:
+        pop()
+    return out
+
+
+def summarize_host_spans(jsonl_path: str) -> dict:
+    """Aggregate an obs event log (JSONL from ``--event-log``) into a
+    per-span-name host-side table shaped like the device per-class one:
+    SELF µs per name (nested time charged to the innermost span, so the
+    total is real wall coverage, not a double count), count, and share.
+    Instant events are counted but carry no duration."""
+    by_tid: dict = collections.defaultdict(list)
+    span_count = collections.Counter()
+    instants = collections.Counter()
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("ph") == "X":
+                by_tid[ev.get("tid", 0)].append(
+                    (ev.get("ts", 0.0), ev.get("dur", 0.0), ev["name"])
+                )
+                span_count[ev["name"]] += 1
+            else:
+                instants[ev["name"]] += 1
+    span_us = collections.Counter()
+    for spans in by_tid.values():
+        span_us.update(_self_span_times(spans))
+    total = sum(span_us.values())
+    return {
+        "events_file": jsonl_path,
+        "host_busy_us": round(total, 1),
+        "by_span_us": {
+            n: round(us, 1) for n, us in span_us.most_common()
+        },
+        "by_span_share": {
+            n: round(us / total, 4) if total else 0.0
+            for n, us in span_us.most_common()
+        },
+        "span_counts": dict(span_count),
+        "instant_counts": dict(instants),
+    }
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default="/tmp/glint_trace_r05")
     ap.add_argument("--steps", type=int, default=0,
                     help="steps inside the trace, for us/step derivation")
+    ap.add_argument("--host-spans", default=None,
+                    help="obs event-log JSONL to merge as a host-side "
+                         "per-span table next to the device classes")
     ap.add_argument("--out", default=None)
-    args = ap.parse_args()
-    doc = summarize(args.trace)
+    args = ap.parse_args(argv)
+    paths = find_xplane_files(args.trace)
+    if not paths:
+        print(
+            f"error: no *.xplane.pb files under {args.trace!r} — pass the "
+            "directory given to jax.profiler.start_trace (or --profile-dir)",
+            file=sys.stderr,
+        )
+        return 2
+    doc = summarize(args.trace, paths)
     if args.steps:
         doc["steps"] = args.steps
         for p in doc["planes"]:
             p["busy_us_per_step"] = round(
                 p["device_busy_us"] / args.steps, 1
             )
+    if args.host_spans:
+        doc["host_spans"] = summarize_host_spans(args.host_spans)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)
     print(json.dumps(doc))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Satellite coverage (ISSUE 3): LatencyHistogram quantile edge cases,
+the bounded TrainingMetrics history, and atomic metric dumps."""
+
+import json
+import os
+
+import numpy as np
+
+from glint_word2vec_tpu.utils.metrics import LatencyHistogram, TrainingMetrics
+
+
+# ----------------------------------------------------------------------
+# LatencyHistogram.quantile edge cases
+# ----------------------------------------------------------------------
+
+
+def test_quantile_empty_histogram_is_zero():
+    h = LatencyHistogram()
+    for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert h.quantile(q) == 0.0
+
+
+def test_quantile_single_sample_stays_in_its_bucket():
+    h = LatencyHistogram()
+    h.record(0.001)
+    i = 0
+    while h._EDGES[i] < 0.001:
+        i += 1
+    lo = h._EDGES[i - 1]
+    for q in (0.01, 0.5, 0.99):
+        v = h.quantile(q)
+        # Interpolation is clamped by the observed max, and can never
+        # fall below the bucket's lower edge.
+        assert lo <= v <= h.max == 0.001
+
+
+def test_quantile_overflow_bucket_sample_beyond_last_edge():
+    h = LatencyHistogram()
+    big = h._EDGES[-1] * 10  # beyond every edge -> the overflow bucket
+    h.record(big)
+    v = h.quantile(0.5)
+    assert h._EDGES[-1] <= v <= big
+    assert h.quantile(0.999) <= h.max == big
+    # Mixed with a normal sample the overflow keeps the top quantile.
+    h.record(0.001)
+    assert h.quantile(0.99) >= h._EDGES[-1]
+    assert h.quantile(0.25) <= 0.0011
+
+
+def test_quantiles_monotone_and_near_truth_under_random_workloads():
+    rng = np.random.default_rng(7)
+    for dist in ("lognormal", "uniform", "bimodal"):
+        h = LatencyHistogram()
+        if dist == "lognormal":
+            samples = rng.lognormal(mean=-6.0, sigma=1.0, size=4000)
+        elif dist == "uniform":
+            samples = rng.uniform(1e-4, 5e-2, size=4000)
+        else:
+            samples = np.concatenate([
+                rng.uniform(2e-4, 4e-4, 2000),
+                rng.uniform(2e-2, 4e-2, 2000),
+            ])
+        for s in samples:
+            h.record(float(s))
+        p50, p95, p99 = (h.quantile(q) for q in (0.50, 0.95, 0.99))
+        assert 0 < p50 <= p95 <= p99 <= h.max
+        # sqrt(2)-spaced buckets put every estimate within ~±20% of the
+        # true quantile; allow slack for interpolation at bucket edges.
+        # Truth uses the CDF-inverse convention the histogram implements
+        # (plain np.quantile interpolates ACROSS the bimodal gap, where
+        # no bucketed estimator can land).
+        for q, est in ((0.50, p50), (0.95, p95), (0.99, p99)):
+            true = float(np.quantile(samples, q, method="inverted_cdf"))
+            assert 0.7 * true <= est <= 1.35 * true, (dist, q, est, true)
+
+
+def test_quantiles_monotone_in_q_exhaustively():
+    rng = np.random.default_rng(11)
+    h = LatencyHistogram()
+    for s in rng.lognormal(-7, 2.0, size=1000):
+        h.record(float(s))
+    qs = np.linspace(0.01, 1.0, 50)
+    vals = [h.quantile(float(q)) for q in qs]
+    assert all(a <= b + 1e-12 for a, b in zip(vals, vals[1:]))
+
+
+# ----------------------------------------------------------------------
+# TrainingMetrics: bounded history + atomic dump
+# ----------------------------------------------------------------------
+
+
+def test_history_bounded_with_drop_count(tmp_path):
+    m = TrainingMetrics(log_every=1, history_max=5)
+    for i in range(12):
+        m.record_step((i + 1) * 10, loss=1.0, alpha=0.01)
+    assert len(m.history) == 5
+    assert m.history_dropped == 7
+    # Newest entries are the ones retained.
+    assert m.history[-1]["step"] == 12 and m.history[0]["step"] == 8
+    p = str(tmp_path / "m.json")
+    m.dump(p)
+    data = json.load(open(p))
+    assert len(data["history"]) == 5
+    assert data["history_dropped"] == 7
+    assert data["summary"]["steps"] == 12
+
+
+def test_dump_is_atomic_no_temp_leftovers(tmp_path):
+    m = TrainingMetrics(log_every=1)
+    m.record_step(10, loss=2.0, alpha=0.01)
+    p = str(tmp_path / "metrics.json")
+    m.dump(p)
+    m.dump(p)  # overwrite path exercises os.replace onto an existing file
+    assert json.load(open(p))["summary"]["steps"] == 1
+    assert os.listdir(tmp_path) == ["metrics.json"]
+
+
+def test_atomic_write_json_helper(tmp_path):
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    p = str(tmp_path / "x.json")
+    atomic_write_json(p, {"a": 1})
+    atomic_write_json(p, {"a": 2})
+    assert json.load(open(p)) == {"a": 2}
+    assert os.listdir(tmp_path) == ["x.json"]

@@ -1,0 +1,295 @@
+"""Unit tests for the obs/ subsystem: event recorder (ring bound, JSONL
+sink, Chrome-trace export), divergence canary, heartbeat server, and the
+Prometheus renderers + text-format lint."""
+
+import json
+import math
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from glint_word2vec_tpu.obs import events as obs_events
+from glint_word2vec_tpu.obs.canary import DivergenceCanary
+from glint_word2vec_tpu.obs.events import EventRecorder
+from glint_word2vec_tpu.obs.heartbeat import HeartbeatServer, TrainingStatus
+from glint_word2vec_tpu.obs.prometheus import (
+    lint_prometheus_text,
+    serving_to_prometheus,
+    training_to_prometheus,
+)
+
+
+# ----------------------------------------------------------------------
+# EventRecorder
+# ----------------------------------------------------------------------
+
+
+def test_recorder_spans_events_and_ring_bound(tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    rec = EventRecorder(capacity=4, jsonl_path=log)
+    with rec.span("outer", tag="a"):
+        time.sleep(0.002)
+        rec.event("inner", k=1)
+    for i in range(8):
+        rec.event("filler", i=i)
+    rec.close()
+
+    # Ring keeps only the newest `capacity`; drops are counted, the
+    # total recorded count is honest.
+    evs = rec.events()
+    assert len(evs) == 4
+    counts = rec.counts()
+    assert counts == {"recorded": 10, "dropped": 6, "capacity": 4}
+
+    # The JSONL sink received EVERY event (it is not ring-bounded).
+    lines = [json.loads(line) for line in open(log) if line.strip()]
+    assert len(lines) == 10
+    span = next(e for e in lines if e["name"] == "outer")
+    assert span["ph"] == "X" and span["dur"] >= 2000  # µs
+    assert span["args"] == {"tag": "a"}
+    inner = next(e for e in lines if e["name"] == "inner")
+    assert inner["ph"] == "i" and inner["args"] == {"k": 1}
+    # Span ts precedes its contained instant; dur covers it.
+    assert span["ts"] <= inner["ts"] <= span["ts"] + span["dur"]
+
+
+def test_chrome_trace_export_round_trips(tmp_path):
+    rec = EventRecorder(capacity=16)
+    with rec.span("phase"):
+        rec.event("tick")
+    out = str(tmp_path / "trace.json")
+    rec.export_chrome_trace(out)
+    doc = json.loads(open(out).read())
+    assert doc["displayTimeUnit"] == "ms"
+    assert len(doc["traceEvents"]) == 2
+    for ev in doc["traceEvents"]:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+    assert doc["otherData"]["wall_t0"] > 0
+
+
+def test_module_level_hooks_no_op_without_recorder():
+    assert obs_events.get_recorder() is None
+    obs_events.emit("nothing", x=1)  # must not raise
+    with obs_events.span("nothing"):
+        pass
+    rec = obs_events.set_recorder(EventRecorder(capacity=8))
+    try:
+        obs_events.emit("seen")
+        with obs_events.span("spanned"):
+            pass
+        names = [e["name"] for e in rec.events()]
+        assert names == ["seen", "spanned"]
+    finally:
+        obs_events.set_recorder(None)
+
+
+# ----------------------------------------------------------------------
+# DivergenceCanary
+# ----------------------------------------------------------------------
+
+
+def test_canary_trips_on_nan_and_inf():
+    c = DivergenceCanary(window=8)
+    assert c.check(1, 0.5) is None
+    reason = c.check(2, float("nan"))
+    assert reason and "non-finite" in reason and c.trips == 1
+    assert c.check(3, float("inf")) and c.trips == 2
+
+
+def test_canary_trips_on_explosion_and_keeps_baseline():
+    c = DivergenceCanary(window=16, factor=10.0, min_history=4)
+    for i in range(6):
+        assert c.check(i, 1.0 + 0.01 * i) is None
+    reason = c.check(7, 50.0)
+    assert reason and "rolling median" in reason
+    # The exploded sample stays OUT of the window: a sustained explosion
+    # keeps tripping instead of normalizing into the baseline.
+    assert c.check(8, 50.0) is not None
+    assert c.trips == 2
+    # Healthy losses still pass.
+    assert c.check(9, 1.2) is None
+
+
+def test_canary_no_explosion_before_min_history():
+    c = DivergenceCanary(window=16, factor=2.0, min_history=8)
+    for i in range(7):
+        assert c.check(i, 1.0) is None
+    # Window too short for the explosion rule; only NaN would trip.
+    assert c.check(7, 100.0) is None
+
+
+# ----------------------------------------------------------------------
+# Heartbeat server (live HTTP endpoints, both /metrics formats)
+# ----------------------------------------------------------------------
+
+
+def _get(host, port, path):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=30
+    ) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_heartbeat_server_endpoints():
+    status = TrainingStatus(pipeline="host", total_epochs=3,
+                            total_words=1000)
+    status.update(state="running", epoch=1, step=42, words_done=400,
+                  alpha=0.02)
+    time.sleep(0.01)
+    status.update(words_done=500)
+    srv = HeartbeatServer(status, port=0)
+    srv.start()
+    try:
+        ctype, body = _get(srv.host, srv.port, "/healthz")
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["state"] == "running"
+        assert health["epoch"] == 1 and health["step"] == 42
+        assert health["words_done"] == 500
+        assert health["words_per_sec_rolling"] > 0
+
+        ctype, body = _get(srv.host, srv.port, "/metrics")
+        assert ctype.startswith("application/json")
+        snap = json.loads(body)
+        assert snap["total_epochs"] == 3 and snap["alpha"] == 0.02
+        assert "device_memory" in snap
+
+        ctype, body = _get(srv.host, srv.port,
+                           "/metrics?format=prometheus")
+        assert ctype.startswith("text/plain")
+        lint_prometheus_text(body)
+        assert "glint_training_steps_total 42" in body
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.host, srv.port, "/nosuchroute")
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_snapshot_json_safe_with_non_finite_values():
+    # A NaN loss (exactly when the heartbeat matters most) must not
+    # produce bare-NaN JSON that strict consumers reject: non-finite
+    # floats serialize as null.
+    class M:
+        host_time = 1.0
+        step_time = 2.0
+        last_loss = float("nan")
+
+    status = TrainingStatus(metrics=M())
+    status.update(alpha=float("inf"))
+    snap = status.snapshot(include_devices=False)
+    parsed = json.loads(json.dumps(snap, allow_nan=False))
+    assert parsed["last_loss"] is None and parsed["alpha"] is None
+
+
+def test_obsrun_init_failure_uninstalls_recorder(tmp_path):
+    # EADDRINUSE on --status-port raises before an ObsRun exists, so no
+    # close() can ever run: the constructor itself must uninstall the
+    # process-wide recorder and release the JSONL sink.
+    import socket
+
+    from glint_word2vec_tpu.obs import ObsConfig, ObsRun
+
+    holder = socket.socket()
+    holder.bind(("127.0.0.1", 0))
+    port = holder.getsockname()[1]
+    try:
+        obs = ObsConfig(status_port=port,
+                        event_log=str(tmp_path / "e.jsonl"))
+        with pytest.raises(OSError):
+            ObsRun(obs)
+        assert obs_events.get_recorder() is None
+    finally:
+        holder.close()
+
+
+def test_heartbeat_healthz_500_on_diverged():
+    status = TrainingStatus()
+    status.update(state="diverged")
+    srv = HeartbeatServer(status, port=0)
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.host, srv.port, "/healthz")
+        assert e.value.code == 500
+        assert json.loads(e.value.read())["status"] == "diverged"
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# Prometheus renderers + lint
+# ----------------------------------------------------------------------
+
+
+def test_training_exposition_lints_and_carries_values():
+    status = TrainingStatus(pipeline="device_corpus", total_epochs=2,
+                            total_words=500)
+    status.update(state="running", epoch=0, step=7, words_done=123)
+    text = training_to_prometheus(status.snapshot())
+    lint_prometheus_text(text)
+    assert "glint_training_words_done_total 123" in text
+    assert 'pipeline="device_corpus"' in text
+    # last_loss unset renders as NaN, which the lint must accept.
+    assert "glint_training_last_loss NaN" in text
+
+
+def test_serving_exposition_lints_from_real_serving_metrics():
+    from glint_word2vec_tpu.utils.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    for _ in range(5):
+        m.observe("/synonyms", 0.002)
+    m.observe("/vector", 0.5, status=404)
+    m.record_batch(1)
+    m.record_batch(4)
+    m.record_batch(4)
+    m.record_cache(True)
+    m.record_cache(False)
+    m.warmup_compiles = 3
+    text = serving_to_prometheus(m.snapshot(total_compiles=3))
+    lint_prometheus_text(text)
+    assert 'glint_serving_requests_total{path="/synonyms"} 5' in text
+    assert 'glint_serving_request_errors_total{path="/vector"} 1' in text
+    # Histogram buckets are cumulative and capped by +Inf == count.
+    assert 'glint_serving_coalesced_batch_size_bucket{le="1"} 1' in text
+    assert 'glint_serving_coalesced_batch_size_bucket{le="4"} 3' in text
+    assert 'glint_serving_coalesced_batch_size_bucket{le="+Inf"} 3' in text
+    assert "glint_serving_coalesced_batch_size_sum 9" in text
+    assert "glint_serving_post_warmup_compiles 0" in text
+
+
+def test_lint_rejects_malformed_expositions():
+    with pytest.raises(ValueError):
+        lint_prometheus_text("metric 1")  # missing trailing newline
+    with pytest.raises(ValueError):
+        lint_prometheus_text("not a metric line!\n")
+    with pytest.raises(ValueError):
+        lint_prometheus_text('bad{label=unquoted} 1\n')
+    with pytest.raises(ValueError):
+        lint_prometheus_text(
+            "# TYPE m counter\n# TYPE m counter\nm 1\n"
+        )  # duplicate TYPE
+    with pytest.raises(ValueError):
+        lint_prometheus_text("m 1\n# TYPE m counter\n")  # TYPE after sample
+    with pytest.raises(ValueError):
+        lint_prometheus_text("# TYPE m flavor\nm 1\n")  # invalid type
+    # Clean input, including NaN and escaped label values, passes.
+    lint_prometheus_text(
+        "# HELP m help text\n# TYPE m gauge\n"
+        'm{path="/a\\"b"} NaN\nm{path="/c"} 1.5e-3\n'
+    )
+
+
+def test_exposition_numbers_are_finite_floats_or_specials():
+    from glint_word2vec_tpu.obs.prometheus import _num
+
+    assert _num(None) == "NaN"
+    assert _num(float("nan")) == "NaN"
+    assert _num(float("inf")) == "+Inf"
+    assert _num(float("-inf")) == "-Inf"
+    assert _num(3) == "3"
+    assert float(_num(0.25)) == 0.25
+    assert not math.isnan(float(_num(7)))

@@ -259,6 +259,18 @@ def test_smoke_every_endpoint_zero_post_warmup_compiles(served):
 
         burst_words = [model.vocab.words[i % model.vocab.size]
                        for i in range(12)]
+
+        # Prometheus exposition mid-smoke: scraping must lint clean and
+        # must not disturb the zero-post-warmup-compile contract the
+        # assertions below enforce (ISSUE 3 acceptance).
+        from glint_word2vec_tpu.obs.prometheus import lint_prometheus_text
+
+        with urllib.request.urlopen(
+            f"http://{smoke.host}:{smoke.port}/metrics?format=prometheus",
+            timeout=30,
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            lint_prometheus_text(r.read().decode())
         errs = []
 
         def hit(w):
@@ -295,6 +307,54 @@ def test_smoke_every_endpoint_zero_post_warmup_compiles(served):
             assert metrics["endpoints"][path]["count"] >= 1
     finally:
         smoke.stop()
+
+
+def test_metrics_prometheus_format(served):
+    # /metrics?format=prometheus renders the SAME snapshot as the JSON
+    # default (which stays the default), passes the text-format lint,
+    # and scraping compiles nothing.
+    from glint_word2vec_tpu.obs.prometheus import lint_prometheus_text
+
+    server, model = served
+    _post(server, "/synonyms", {"word": model.vocab.words[0], "num": 3})
+    before = model.engine.query_compiles
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/metrics?format=prometheus",
+        timeout=30,
+    ) as r:
+        assert r.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"
+        )
+        text = r.read().decode()
+    lint_prometheus_text(text)
+    assert 'glint_serving_requests_total{path="/synonyms"}' in text
+    assert "glint_serving_compiles_total" in text
+    assert model.engine.query_compiles == before
+
+    # JSON stays the default format, unchanged shape.
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/metrics", timeout=30
+    ) as r:
+        assert r.headers["Content-Type"].startswith("application/json")
+        snap = json.loads(r.read())
+    assert "endpoints" in snap and "compiles" in snap
+    # The format variant query string must not mint its own metric key.
+    assert all("format=" not in k for k in snap["endpoints"])
+
+
+def test_post_query_string_routes_and_keys_on_bare_path(served):
+    # POST routing and metric keying use the parsed path, so a query
+    # string neither 404s a real endpoint nor mints a fresh histogram.
+    server, model = served
+    out = _post(server, "/synonyms?trace=1",
+                {"word": model.vocab.words[0], "num": 3})
+    assert len(out) == 3
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/metrics", timeout=30
+    ) as r:
+        snap = json.loads(r.read())
+    assert "/synonyms?trace=1" not in snap["endpoints"]
+    assert snap["endpoints"]["/synonyms"]["count"] >= 1
 
 
 def test_synonym_cache_hit_invalidation_and_bound(served):

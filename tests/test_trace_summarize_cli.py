@@ -1,0 +1,91 @@
+"""Satellite coverage (ISSUE 3): scripts/trace_summarize.py must fail a
+trace-less invocation with one clean line (not a stack trace), stamp a
+schema_version into its output, and merge obs host-span logs."""
+
+import importlib.util
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "trace_summarize_cli", os.path.join(ROOT, "scripts", "trace_summarize.py")
+)
+ts = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ts)
+
+
+def test_no_xplane_files_exits_with_one_clean_line(tmp_path, capsys):
+    rc = ts.main(["--trace", str(tmp_path)])
+    assert rc == 2
+    captured = capsys.readouterr()
+    err_lines = [line for line in captured.err.splitlines() if line]
+    assert len(err_lines) == 1
+    assert err_lines[0].startswith("error: no *.xplane.pb files")
+    assert "Traceback" not in captured.err
+    assert captured.out == ""  # no partial JSON on the error path
+
+
+def test_missing_trace_dir_also_errors_cleanly(tmp_path, capsys):
+    rc = ts.main(["--trace", str(tmp_path / "nope")])
+    assert rc == 2
+    assert capsys.readouterr().err.startswith("error:")
+
+
+def test_schema_version_stamped_into_doc():
+    doc = ts.summarize("/definitely/empty", paths=[])
+    assert doc["schema_version"] == ts.SCHEMA_VERSION == 2
+    assert doc["xplane_files"] == 0 and doc["planes"] == []
+    json.loads(json.dumps(doc))  # JSON-serializable round trip
+
+
+def test_host_span_merge_aggregates_event_log(tmp_path):
+    log = tmp_path / "events.jsonl"
+    events = [
+        {"name": "device_steps", "ph": "X", "ts": 0.0, "dur": 1500.0},
+        {"name": "device_steps", "ph": "X", "ts": 2000.0, "dur": 500.0},
+        {"name": "host_batch", "ph": "X", "ts": 3000.0, "dur": 1000.0},
+        {"name": "table_mutation", "ph": "i", "ts": 10.0},
+        {"name": "table_mutation", "ph": "i", "ts": 20.0},
+    ]
+    log.write_text(
+        "\n".join(json.dumps(e) for e in events) + "\n\n"  # blank line ok
+    )
+    doc = ts.summarize_host_spans(str(log))
+    assert doc["host_busy_us"] == 3000.0
+    assert doc["by_span_us"] == {"device_steps": 2000.0,
+                                 "host_batch": 1000.0}
+    assert doc["span_counts"] == {"device_steps": 2, "host_batch": 1}
+    assert doc["instant_counts"] == {"table_mutation": 2}
+    assert abs(doc["by_span_share"]["device_steps"] - 2 / 3) < 1e-3
+
+
+def test_host_span_merge_charges_nested_time_to_innermost(tmp_path):
+    # fastText's subword_expand runs INSIDE device_steps: the parent's
+    # self time must exclude the child or host_busy_us double-counts.
+    log = tmp_path / "nested.jsonl"
+    events = [
+        {"name": "device_steps", "ph": "X", "ts": 0.0, "dur": 1000.0,
+         "tid": 1},
+        {"name": "subword_expand", "ph": "X", "ts": 100.0, "dur": 300.0,
+         "tid": 1},
+        # A different thread's span must not be treated as nested.
+        {"name": "heartbeat", "ph": "X", "ts": 100.0, "dur": 50.0,
+         "tid": 2},
+    ]
+    log.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    doc = ts.summarize_host_spans(str(log))
+    assert doc["by_span_us"] == {"device_steps": 700.0,
+                                 "subword_expand": 300.0,
+                                 "heartbeat": 50.0}
+    assert doc["host_busy_us"] == 1050.0
+
+
+def test_host_spans_flag_still_requires_a_trace(tmp_path, capsys):
+    # The merge rides along a device-trace summary; a trace-less
+    # invocation errors the same way with or without --host-spans.
+    log = tmp_path / "e.jsonl"
+    log.write_text('{"name": "x", "ph": "X", "ts": 0, "dur": 1}\n')
+    rc = ts.main(["--trace", str(tmp_path), "--host-spans", str(log)])
+    assert rc == 2
+    assert capsys.readouterr().err.startswith("error:")
