@@ -23,16 +23,29 @@ UNAVAILABLE at import), the orchestrator retries once, then falls back to
 CPU with the platform recorded, then — only if even that fails — emits a
 diagnostic JSON line. It always exits 0 with one JSON line on stdout.
 
+Every mode reports ``pairs_per_sec`` (measured useful (center, context)
+pairs trained per second) and ``effective_words_per_sec`` :=
+``pairs_per_sec / context_lanes`` — useful-pair throughput in dense-word
+units, the number on which grid-vs-packed dispatch shapes are directly
+comparable. (The naive ``words_per_sec / mask_density`` form would
+INFLATE a mode by its own masked-lane waste — a grid cell at density
+0.43 would score 2.3x its real training rate — so the pair-normalized
+form is what the packed-vs-grid gate in BENCH_PACKED.json uses.)
+
 Environment knobs:
   BENCH_VOCAB, BENCH_DIM, BENCH_BATCH, BENCH_SPC (minibatches per device
   dispatch = scan length), BENCH_SHARED_NEG (pool size for the shared mode),
   BENCH_MODES (default
-  "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,corpus_subsample" —
-  "corpus" is the production fit/fit_file path with minibatches assembled
+  "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,corpus_subsample,corpus_packed"
+  — "corpus" is the production fit/fit_file path with minibatches assembled
   on device from the uploaded corpus; "corpus_subsample" is the same path
   with frequency subsampling on (ratio BENCH_SUBSAMPLE, default 1e-3):
   a per-epoch on-device compaction pass, then training over the
-  compacted stream — the realistic production config; suffixes:
+  compacted stream — the realistic production config; "corpus_packed" is
+  the corpus path under dense pair packing (set_batch_packing("dense"),
+  ISSUE 4): valid pairs prefix-sum-compacted into dense pair batches of
+  batch*context_lanes slots, reported with packed fill as its
+  mask_density; suffixes:
   "_bf16c" = bf16 MXU operands with f32 accumulation, "_bf16t" = bf16
   TABLES for that mode (overriding BENCH_DTYPE; halves gather/scatter
   bytes), "_bf16ct" = both), BENCH_DTYPE (run-level table dtype, default
@@ -104,7 +117,8 @@ def _config_from_env():
         # + the fastest estimator config + both production paths.
         "modes": os.environ.get(
             "BENCH_MODES",
-            "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,corpus_subsample",
+            "per_pair,per_pair_bf16ct,shared_bf16ct,corpus,"
+            "corpus_subsample,corpus_packed",
         ),
     }
 
@@ -233,10 +247,11 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     )
 
     p = (counts / counts.sum()).astype(np.float64)
-    if estimator in ("corpus", "corpus_subsample"):
+    if estimator in ("corpus", "corpus_subsample", "corpus_packed"):
         return _bench_corpus_mode(
             jax, eng, cfg, np, compute_dtype, p,
             subsample=(estimator == "corpus_subsample"),
+            packed=(estimator == "corpus_packed"),
         )
 
     rng = np.random.default_rng(0)
@@ -297,6 +312,10 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     del eng  # release the two V x d tables before the next mode runs
     return {
         "words_per_sec": round(wps, 1),
+        # Useful-pair throughput + its dense-word normalization (see
+        # module docstring): the grid-vs-packed comparable numbers.
+        "pairs_per_sec": round(wps * C * density, 1),
+        "effective_words_per_sec": round(wps * density, 1),
         "step_time_us": round(dt / steps * 1e6, 1),
         "compile_s": round(compile_s, 1),
         "flops_per_sec": round(flops, 3),
@@ -310,13 +329,19 @@ def _bench_mode(jax, mesh, cfg, mode: str, np):
     }
 
 
-def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p, subsample=False):
+def _bench_corpus_mode(
+    jax, eng, cfg, np, compute_dtype, p, subsample=False, packed=False,
+):
     """The production fit/fit_file hot path: the flat Zipf corpus uploaded
     to HBM once, every minibatch assembled INSIDE the jitted train scan
     (ops/device_batching window shrinkage + sentence bounds); per-dispatch
     host->device traffic is scalars only. With ``subsample`` the per-epoch
     on-device subsample-compact pass runs first (the realistic production
-    config) and training covers the compacted stream."""
+    config) and training covers the compacted stream. With ``packed`` the
+    scan runs the DENSE pair-packing dispatch (ISSUE 4): valid pairs
+    compacted into batch*context_lanes pair slots per step — same nominal
+    step FLOPs as a grid dispatch, ~1/density more corpus positions
+    covered per step; its ``mask_density`` is the packed fill."""
     V, B, spc = cfg["vocab"], cfg["batch"], cfg["steps_per_call"]
     # Window sized so the device batcher's lane count (2W-3) matches the
     # context_lanes the FLOPs formula charges.
@@ -346,31 +371,77 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p, subsample=False):
         compact_s = time.time() - t0  # steady-state per-epoch cost
     alphas = np.full(spc, 0.025, np.float32)
     key = jax.random.PRNGKey(0)
-
-    t0 = time.time()
-    losses = eng.train_steps_corpus(0, B, W, key, alphas, 0)
-    jax.block_until_ready(losses)
-    compile_s = time.time() - t0
-
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", 2.0))
     max_calls = int(os.environ.get("BENCH_MAX_CALLS", 50))
-    span = max(n_pos - spc * B, 1)  # wrap so no dispatch hits the epoch tail
-    t0 = time.time()
-    calls, last, words = 0, None, 0
-    while calls < max_calls:
-        start = (calls * spc * B) % span
-        last = eng.train_steps_corpus(start, B, W, key, alphas, calls * spc)
-        # Credit only LIVE positions: an aggressive ratio can compact
-        # n_pos below one dispatch's coverage, and the tail rows past
-        # n_pos are zero-mask no-ops that must not count as trained words.
-        words += max(0, min(n_pos, start + spc * B) - start)
-        calls += 1
-        if calls >= 2 and time.time() - t0 >= min_seconds:
-            break
-    jax.block_until_ready(last)
-    dt = time.time() - t0
+    C = cfg["context_lanes"]
+    pairs_done = None
 
-    steps = calls * spc
+    if packed:
+        # Pair slots per step = the grid step's lane count, so one packed
+        # dispatch costs the same nominal contraction FLOPs as one grid
+        # dispatch; LR params pinned so alpha ~= the grid loop's 0.025.
+        P = B * C
+        pk = dict(
+            step_size=0.025, total_words=10**12, words_base=0,
+        )
+        t0 = time.time()
+        res = eng.train_steps_corpus_packed(
+            0, P, W, B, key, spc, step0=0, grid_step0=0, **pk
+        )
+        jax.block_until_ready(res[0])
+        compile_s = time.time() - t0
+
+        t0 = time.time()
+        calls, words, pairs_done, pos, live_slots = 0, 0, 0, 0, 0
+        while calls < max_calls:
+            if pos >= n_pos:
+                pos = 0  # epoch wrap
+            res = eng.train_steps_corpus_packed(
+                pos, P, W, B, key, spc, step0=calls * spc, grid_step0=0,
+                **pk,
+            )
+            # The (K,)-scalar readback the production loop also performs
+            # per dispatch — the data-dependent position advance.
+            pos_ends = np.asarray(res[2])
+            pairs_done += int(np.asarray(res[1]).sum())
+            # Fill denominator counts LIVE steps only (same rule as the
+            # fit loop's packed_mask_density): steps past the corpus end
+            # are zero-pair no-ops, and charging their empty slots would
+            # understate the per-dispatch fill on epoch crossings.
+            starts = np.concatenate(([pos], pos_ends[:-1]))
+            live_slots += int((starts < n_pos).sum()) * P
+            words += max(0, min(n_pos, int(pos_ends[-1])) - pos)
+            pos = int(pos_ends[-1])
+            calls += 1
+            if calls >= 2 and time.time() - t0 >= min_seconds:
+                break
+        dt = time.time() - t0
+        steps = calls * spc
+    else:
+        t0 = time.time()
+        losses = eng.train_steps_corpus(0, B, W, key, alphas, 0)
+        jax.block_until_ready(losses)
+        compile_s = time.time() - t0
+
+        span = max(n_pos - spc * B, 1)  # wrap: no dispatch hits the tail
+        t0 = time.time()
+        calls, last, words = 0, None, 0
+        while calls < max_calls:
+            start = (calls * spc * B) % span
+            last = eng.train_steps_corpus(
+                start, B, W, key, alphas, calls * spc
+            )
+            # Credit only LIVE positions: an aggressive ratio can compact
+            # n_pos below one dispatch's coverage, and the tail rows past
+            # n_pos are zero-mask no-ops that must not count as trained
+            # words.
+            words += max(0, min(n_pos, start + spc * B) - start)
+            calls += 1
+            if calls >= 2 and time.time() - t0 >= min_seconds:
+                break
+        jax.block_until_ready(last)
+        dt = time.time() - t0
+        steps = calls * spc
 
     # MEASURED mask density of the device-assembled windows (shrink draw
     # + sentence-bound clipping leave ~0.42 of the lanes live at W=5:
@@ -395,8 +466,37 @@ def _bench_corpus_mode(jax, eng, cfg, np, compute_dtype, p, subsample=False):
     )
     density = float(np.asarray(probe_mask).mean())
     del probe_mask
+    if packed:
+        # mask_density for the packed cell is the measured FILL of the
+        # dense pair batches (live pairs / dispatched pair slots); the
+        # grid density of the same corpus is echoed for context — it is
+        # the waste packing removed. pairs/sec is measured directly.
+        d_, n_ = cfg["dim"], cfg["negatives"]
+        fill = pairs_done / max(live_slots, 1)
+        out = {
+            "words_per_sec": round(words / dt, 1),
+            "pairs_per_sec": round(pairs_done / dt, 1),
+            "effective_words_per_sec": round(pairs_done / dt / C, 1),
+            "step_time_us": round(dt / steps * 1e6, 1),
+            "compile_s": round(compile_s, 1),
+            "flops_per_sec": round(
+                (6.0 * d_ * (1 + n_) * pairs_done + words * d_) / dt, 3
+            ),
+            "mask_density": round(fill, 4),
+            "grid_mask_density": round(density, 4),
+            "pair_batch": B * C,
+            "timed_steps": steps,
+            "table_dtype": str(eng.syn0.dtype),
+            "compute_dtype": compute_dtype,
+            "corpus_words_device": int(N),
+            "window": W,
+            "inputs": "device_corpus_packed",
+        }
+        return out
     out = {
         "words_per_sec": round(words / dt, 1),
+        "pairs_per_sec": round(words / dt * C * density, 1),
+        "effective_words_per_sec": round(words / dt * density, 1),
         "step_time_us": round(dt / steps * 1e6, 1),
         "compile_s": round(compile_s, 1),
         "flops_per_sec": round(

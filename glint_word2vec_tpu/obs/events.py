@@ -44,6 +44,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def update(self, **args) -> None:
+        """No-op twin of :meth:`_Span.update`."""
+
 
 NULL_SPAN = _NullSpan()
 
@@ -64,6 +67,13 @@ class _Span:
         t1 = time.perf_counter()
         self._rec._record(self._name, "X", self._t0, t1 - self._t0, self._args)
         return False
+
+    def update(self, **args) -> None:
+        """Amend the span's attributes before it closes — for values only
+        known mid-span (the packed train loop's live step count is read
+        back from the device inside the span). Args are recorded at
+        ``__exit__``, so updates land in the emitted event."""
+        self._args.update(args)
 
 
 class EventRecorder:

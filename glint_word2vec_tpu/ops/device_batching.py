@@ -34,6 +34,17 @@ arrays with ``n_kept`` as its (traced) corpus-end bound — so
 ``subsample_ratio > 0`` keeps the scalars-only dispatch path instead of
 falling back to the host batcher (models/word2vec.py routes the device
 path for both settings).
+
+The shrink draw plus sentence clipping leave only ~0.43 of the ``(B, C)``
+context grid live (``E[max(2b-1, 0)]/C`` for ``b ~ U[0, W)``), so the
+grid-shaped step burns >2x the FLOPs per useful pair. The PACKED dispatch
+mode (:func:`pack_window_pairs`, ``set_batch_packing("dense")``) fixes
+that the pSGNScc way (arxiv 1604.04661: restructure the batch so the
+matrix work is dense): windows are assembled over an oversized candidate
+span, the valid (center, context) pairs are prefix-sum scatter-compacted
+into a fixed-shape dense pair list, and the step runs the rank-1 SGNS
+update over pairs — effective mask density ~0.43 -> >=0.95 on the corpus
+path at the same dispatched step cost.
 """
 
 from __future__ import annotations
@@ -171,6 +182,151 @@ def subsample_compact(
     kept_before = jnp.concatenate([jnp.zeros(1, jnp.int32), incl])
     offsets_c = kept_before[offsets].astype(jnp.int32)
     return ids_c, offsets_c, n_kept
+
+
+def grid_window_shrink(
+    base_key: jax.Array,
+    positions: jax.Array,  # (S,) int32 center positions, >= 0
+    grid_batch: int,  # B of the grid scan being reproduced
+    grid_step0,  # traced uint32: grid step counter at position 0
+    window: int,
+) -> jax.Array:
+    """The window-shrink draw the GRID corpus scan makes for each position.
+
+    In the grid scan (parallel/engine.make_corpus_scan) position ``p``
+    lands in grid step ``grid_step0 + p // B`` at batch row ``p % B``, and
+    its shrink is drawn from
+    ``fold_in(fold_in(fold_in(base_key, step), WINDOW_FOLD), row)``. The
+    packed scan reproduces exactly those draws — a deterministic function
+    of the global position — so the packed pair stream is the *same
+    multiset of valid (center, context) pairs* the grid scan trains on
+    (the parity contract tests/test_packed.py pins down), and is
+    mesh-invariant for free (no dependence on where packing boundaries or
+    data ranks fall).
+    """
+    W = int(window)
+    gi = (positions // jnp.int32(grid_batch)).astype(jnp.uint32)
+    row = (positions % jnp.int32(grid_batch)).astype(jnp.uint32)
+
+    def draw(g, r):
+        k = jax.random.fold_in(base_key, grid_step0 + g)
+        k = jax.random.fold_in(k, WINDOW_FOLD)
+        k = jax.random.fold_in(k, r)
+        return jax.random.randint(k, (), 0, W, dtype=jnp.int32)
+
+    return jax.vmap(draw)(gi, row)
+
+
+def pack_window_pairs(
+    ids: jax.Array,  # (N,) int32 flat corpus (active view)
+    offsets: jax.Array,  # (S+1,) int32 sentence offsets (active view)
+    pos,  # traced int32 scalar: first unconsumed center position
+    base_key: jax.Array,
+    grid_step0,  # traced uint32 (see grid_window_shrink)
+    *,
+    window: int,
+    span: int,  # candidate center positions examined per step
+    pair_batch: int,  # P: dense pair slots per step
+    grid_batch: int,  # B of the grid scan whose draws are reproduced
+    n_valid,  # traced int32 corpus-end bound
+):
+    """Assemble one DENSE (center, context) pair batch on device.
+
+    Windows are built over the oversized candidate span
+    ``[pos, pos + span)`` exactly as :func:`device_window_batch` builds
+    them (shrink draw + sentence bounds), then the valid pairs are
+    prefix-sum scatter-compacted to the front of a fixed ``(P,)`` pair
+    list — the same compaction machinery :func:`subsample_compact` proved
+    out, applied to pair lanes instead of tokens. Only *whole* center
+    positions are consumed: ``n_cons`` is the largest prefix of the span
+    whose cumulative valid-pair count fits in ``P``, so the unconsumed
+    remainder is carried simply as the position counter (positions past
+    ``pos + n_cons`` are re-assembled next step — the draws are
+    position-deterministic, so recomputation is exact) and no partial
+    position ever splits across steps.
+
+    Returns ``(pcenters (P,), pcontexts (P,), pmask (P,), n_cons (),
+    n_pairs ())``: the dense pair list in position-major, lane-minor
+    order (slots past ``n_pairs`` are index-0 / mask-0 padding),
+    the consumed-position advance, and the live pair count. Guarantees
+    ``n_cons >= 1`` whenever ``P >= context_width(window)`` (a single
+    position yields at most C pairs), so the scan always makes progress;
+    positions at or past ``n_valid`` contribute zero pairs but are still
+    consumed (the epoch tail drains in ``span``-sized strides).
+    """
+    N = ids.shape[0]
+    W = int(window)
+    S = int(span)
+    P = int(pair_batch)
+    offs = jnp.asarray(window_offsets(W), dtype=jnp.int32)  # (C,) static
+    C = offs.shape[0]
+    if P < C:
+        raise ValueError(f"pair_batch ({P}) must be >= context lanes ({C})")
+
+    positions = pos + jnp.arange(S, dtype=jnp.int32)
+    in_corpus = (positions >= 0) & (positions < n_valid)
+    p = jnp.clip(positions, 0, max(N - 1, 0))
+    sent = jnp.searchsorted(offsets, p, side="right") - 1
+    start = offsets[sent]
+    end = offsets[sent + 1]
+    b = grid_window_shrink(base_key, positions, grid_batch, grid_step0, W)
+    cpos = p[:, None] + offs[None, :]
+    valid = (
+        (offs[None, :] >= -b[:, None])
+        & (offs[None, :] <= b[:, None] - 1)
+        & (cpos >= start[:, None])
+        & (cpos < end[:, None])
+        & in_corpus[:, None]
+    )  # (S, C)
+    centers = jnp.where(in_corpus, ids[p], 0).astype(jnp.int32)
+    contexts = jnp.where(
+        valid, ids[jnp.clip(cpos, 0, max(N - 1, 0))], 0
+    ).astype(jnp.int32)
+
+    # Whole-position consumption: take the longest span prefix whose
+    # cumulative pair count fits in P (cum is non-decreasing, so the
+    # count of cum <= P IS that prefix length).
+    v = valid.sum(axis=1).astype(jnp.int32)  # (S,)
+    cum = jnp.cumsum(v)
+    n_cons = jnp.sum((cum <= P).astype(jnp.int32))
+    consumed = jnp.arange(S, dtype=jnp.int32) < n_cons
+
+    take = (valid & consumed[:, None]).reshape(-1).astype(jnp.int32)
+    incl = jnp.cumsum(take)
+    n_pairs = incl[-1]  # == cum[n_cons - 1] <= P by construction
+    dest = incl - take
+    scatter_idx = jnp.where(take > 0, dest, P)  # dropped lanes out of range
+    pcenters = (
+        jnp.zeros(P, jnp.int32)
+        .at[scatter_idx]
+        .set(jnp.repeat(centers, C), mode="drop")
+    )
+    pcontexts = (
+        jnp.zeros(P, jnp.int32)
+        .at[scatter_idx]
+        .set(contexts.reshape(-1), mode="drop")
+    )
+    pmask = (jnp.arange(P, dtype=jnp.int32) < n_pairs).astype(jnp.float32)
+    return pcenters, pcontexts, pmask, n_cons, n_pairs
+
+
+def device_words_done(
+    offsets: jax.Array,  # (S+1,) int32 ORIGINAL sentence offsets
+    offsets_c: jax.Array,  # (S+1,) int32 active (possibly compacted) offsets
+    end_position,  # traced int32: consumed center positions [0, end)
+    n_valid,  # traced int32: live extent of the active position stream
+) -> jax.Array:
+    """Traced restatement of :func:`corpus_words_done_compacted` — the
+    pre-subsampling words_done rule, evaluated inside the jitted packed
+    scan so the LR anneal can follow the data-dependent position advance
+    without a host round-trip. For the un-subsampled stream pass the
+    original offsets as both arguments (then this equals
+    :func:`corpus_words_done`). Bit-for-bit the host rule: the parity
+    test drives both over every prefix."""
+    j = jnp.searchsorted(offsets_c, end_position - 1, side="right") - 1
+    done = offsets[jnp.clip(j + 1, 0, offsets.shape[0] - 1)]
+    done = jnp.where(end_position >= n_valid, offsets[-1], done)
+    return jnp.where(end_position <= 0, 0, done).astype(jnp.int32)
 
 
 def corpus_words_done(offsets: np.ndarray, end_position: int) -> int:

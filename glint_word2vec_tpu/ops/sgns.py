@@ -346,6 +346,36 @@ def train_step(
     return syn0, syn1, g.loss
 
 
+def train_step_pairs(
+    syn0: jax.Array,  # (V, d)
+    syn1: jax.Array,  # (V, d)
+    prob: jax.Array,  # (V,) alias acceptance probs
+    alias: jax.Array,  # (V,) alias targets
+    centers: jax.Array,  # (P,) int32 — one CENTER per pair row
+    contexts: jax.Array,  # (P,) int32 — one CONTEXT per pair row
+    pair_mask: jax.Array,  # (P,) float32 — 1.0 where the pair is real
+    key: jax.Array,
+    alpha: jax.Array,  # () float32
+    num_negatives: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused SGNS update over a DENSE pair list — the packed-dispatch
+    step (ops/device_batching.pack_window_pairs feeds it). Each batch row
+    is exactly one (center, context) pair, i.e. the C=1 specialization of
+    :func:`train_step`: no lane of the contraction is ever masked padding,
+    so every dispatched FLOP is a useful pair (the pSGNScc dense-batch
+    restructuring, arxiv 1604.04661). Negatives are drawn per PAIR row,
+    keyed by the row's global index — the same mesh-invariant keying
+    discipline as :func:`~glint_word2vec_tpu.ops.sampling
+    .sample_negatives_per_row` everywhere else. Because scatter-adds sum,
+    decomposing a grid batch into its valid pairs and feeding them here
+    applies the identical table update (pinned by the decomposition test
+    in tests/test_packed.py)."""
+    return train_step(
+        syn0, syn1, prob, alias, centers, contexts[:, None],
+        pair_mask[:, None], key, alpha, num_negatives,
+    )
+
+
 def sgns_loss(
     syn0: jax.Array,
     syn1: jax.Array,

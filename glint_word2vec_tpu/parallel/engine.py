@@ -745,6 +745,92 @@ class EmbeddingEngine:
             )
 
         self._make_corpus_scan = make_corpus_scan
+        self._packed_scan_cache: dict = {}
+
+        def make_packed_corpus_scan(P: int, W: int, B_grid: int, S: int,
+                                    K: int):
+            # PACKED corpus-resident scan (ISSUE 4): instead of a (B, C)
+            # context grid that is ~57% masked lanes, each step assembles
+            # windows over an oversized candidate span of center
+            # positions, prefix-sum-compacts the valid (center, context)
+            # pairs into a DENSE (P,) pair list
+            # (ops/device_batching.pack_window_pairs), and runs the
+            # step body in its pair form — batch rows ARE pairs (C=1), so
+            # no contraction lane is masked padding. The position counter
+            # advances data-dependently by whole consumed positions and is
+            # carried through the scan; the LR alpha is derived on device
+            # from the traced consumed-position count via the same
+            # pre-subsampling words_done rule the host uses
+            # (device_words_done == corpus_words_done_compacted). The
+            # assembly is computed replicated on every rank (it is a
+            # deterministic function of replicated inputs — mesh-invariant
+            # by construction); each data rank then slices its own
+            # Pl = P/num_data pair rows, and negatives are keyed by GLOBAL
+            # pair row exactly like every other path
+            # (sample_negatives_per_row discipline). Window-shrink draws
+            # reproduce the grid scan's position->draw mapping
+            # (grid_window_shrink), so the packed stream trains the exact
+            # same valid-pair multiset as the grid path at the same
+            # (B_grid, key schedule) — the parity gate that keeps "grid"
+            # the default until it holds.
+            from glint_word2vec_tpu.ops.device_batching import (
+                device_words_done,
+                pack_window_pairs,
+            )
+
+            Pl = P // num_data
+
+            def local_packed_scan(syn0_l, syn1_l, prob, alias, ids, soffs,
+                                  orig_offs, n_valid, pstart, base_key,
+                                  step0, grid_step0, step_size,
+                                  inv_total_words, words_base):
+                drank = lax.axis_index(DATA_AXIS)
+
+                def body(carry, i):
+                    s0, s1, pos = carry
+                    key = jax.random.fold_in(base_key, step0 + i)
+                    pc, px, pm, n_cons, n_pairs = pack_window_pairs(
+                        ids, soffs, pos, base_key, grid_step0,
+                        window=W, span=S, pair_batch=P, grid_batch=B_grid,
+                        n_valid=n_valid,
+                    )
+                    pos_end = pos + n_cons
+                    done = device_words_done(
+                        orig_offs, soffs, pos_end, n_valid
+                    )
+                    wd = words_base + done.astype(jnp.float32)
+                    alpha = jnp.maximum(
+                        step_size * (1.0 - wd * inv_total_words),
+                        step_size * 1e-4,
+                    )
+                    c_l = lax.dynamic_slice_in_dim(pc, drank * Pl, Pl)
+                    x_l = lax.dynamic_slice_in_dim(px, drank * Pl, Pl)
+                    m_l = lax.dynamic_slice_in_dim(pm, drank * Pl, Pl)
+                    cmask = jnp.ones((Pl, 1), jnp.float32)
+                    s0, s1, loss = step_body(
+                        s0, s1, prob, alias, c_l[:, None], cmask,
+                        x_l[:, None], m_l[:, None], key, alpha,
+                    )
+                    return (s0, s1, pos_end), (loss, n_pairs, pos_end, alpha)
+
+                (syn0_l, syn1_l, _), ys = lax.scan(
+                    body,
+                    (syn0_l, syn1_l, pstart),
+                    jnp.arange(K, dtype=jnp.uint32),
+                )
+                losses, pair_counts, pos_ends, alphas = ys
+                return syn0_l, syn1_l, losses, pair_counts, pos_ends, alphas
+
+            return jax.jit(
+                self._shard_map(
+                    local_packed_scan,
+                    in_specs=(tspec, tspec) + (rep,) * 13,
+                    out_specs=(tspec, tspec, rep, rep, rep, rep),
+                ),
+                donate_argnums=(0, 1),
+            )
+
+        self._make_packed_corpus_scan = make_packed_corpus_scan
 
         dims = self.layout == "dims"
         dcols = self.cols_per_shard
@@ -1230,6 +1316,89 @@ class EmbeddingEngine:
         )
         self._tick_tables("train_steps_corpus")
         return losses
+
+    def train_steps_corpus_packed(
+        self, start_position: int, pair_batch: int, window: int,
+        grid_batch: int, base_key, n_steps: int, step0: int = 0,
+        grid_step0: int = 0, *, step_size: float = 0.025,
+        total_words: int = 1, words_base: int = 0,
+        span: Optional[int] = None,
+    ):
+        """K = ``n_steps`` PACKED minibatches over the active corpus view
+        — the dense-pair alternative to :meth:`train_steps_corpus`
+        (``set_batch_packing("dense")`` routes here). Each step packs the
+        next valid (center, context) pairs of the position stream into a
+        dense ``pair_batch``-slot batch and applies the rank-1 SGNS
+        update over pairs, so ~every dispatched contraction lane is a
+        real pair (grid dispatches run ~0.43 live lanes at window 5).
+
+        The consumed-position advance is data-dependent and carried
+        through the scan; LR alphas are computed ON DEVICE from the
+        traced advance with the host's exact pre-subsampling words_done
+        rule, parameterized by ``step_size``, ``total_words`` (the LR
+        denominator, ``num_iterations * train_words + 1``) and
+        ``words_base`` (words credited before this epoch).
+
+        ``grid_batch``/``grid_step0`` pin the window-shrink RNG stream to
+        the grid scan's position->draw mapping (see
+        ops/device_batching.grid_window_shrink): with the batch size and
+        per-epoch step base a grid run would use, the packed run consumes
+        the exact same valid-pair multiset per epoch. Negatives are keyed
+        by global PAIR row under the ``fold_in(base_key, step0 + i)``
+        schedule — mesh-invariant, but a different draw stream than the
+        grid path's (like host-vs-device RNG divergence, documented).
+
+        Returns ``(losses (K,), pair_counts (K,), pos_ends (K,),
+        alphas (K,))`` — per-step loss, live pairs packed, consumed
+        position after the step, and the device-computed alpha. The
+        caller reads ``pos_ends[-1]`` to schedule the next dispatch
+        (one scalar readback per K steps).
+        """
+        if getattr(self, "_corpus", None) is None:
+            raise ValueError("no corpus uploaded (call upload_corpus first)")
+        from glint_word2vec_tpu.corpus.batching import context_width
+
+        P, W, B = int(pair_batch), int(window), int(grid_batch)
+        C = context_width(W)
+        if P % self.num_data:
+            raise ValueError(
+                f"pair batch {P} not divisible by data axis {self.num_data}"
+            )
+        if P < C:
+            raise ValueError(
+                f"pair_batch ({P}) must be >= context lanes ({C})"
+            )
+        if span is None:
+            # Enough candidates that the cumulative valid-pair count
+            # almost always reaches P (expected live lanes per position
+            # is ~0.43*C at W=5, ~0.5*C at W=2): 3*P/C positions carry
+            # ~1.3-1.5x P expected pairs, so underfill is confined to
+            # the epoch tail.
+            span = -(-3 * P // C)
+        S, K = int(span), int(n_steps)
+        fn = self._packed_scan_cache.get((P, W, B, S, K))
+        if fn is None:
+            fn = self._packed_scan_cache[(P, W, B, S, K)] = (
+                self._make_packed_corpus_scan(P, W, B, S, K)
+            )
+        if getattr(self, "_corpus_compacted", None) is not None:
+            ids, soffs = self._corpus_compacted
+            n_valid = self._n_kept
+        else:
+            ids, soffs = self._corpus
+            n_valid = ids.shape[0]
+        (
+            self.syn0, self.syn1, losses, pair_counts, pos_ends, alphas,
+        ) = fn(
+            self.syn0, self.syn1, self._prob, self._alias, ids, soffs,
+            self._corpus[1], jnp.int32(n_valid),
+            jnp.int32(start_position), base_key, jnp.uint32(step0),
+            jnp.uint32(grid_step0), jnp.float32(step_size),
+            jnp.float32(1.0 / float(total_words)),
+            jnp.float32(words_base),
+        )
+        self._tick_tables("train_steps_corpus_packed")
+        return losses, pair_counts, pos_ends, alphas
 
     # ------------------------------------------------------------------
     # Serving ops (the BigWord2VecMatrix query surface)

@@ -105,6 +105,16 @@ class Word2VecParams:
     layout: str = "rows"
     steps_per_call: int = 16
     shared_negatives: int = 0
+    #: Device-resident corpus dispatch shape: "grid" (default) assembles
+    #: (batch, context) window grids — the reference's shape, ~43% live
+    #: lanes at window 5 — while "dense" prefix-sum-compacts the valid
+    #: (center, context) pairs into dense fixed-shape pair batches before
+    #: the update (ops/device_batching.pack_window_pairs), spending ~every
+    #: dispatched FLOP on a real pair. Same valid-pair multiset per epoch
+    #: (window draws reproduce the grid mapping); negative/loss RNG
+    #: streams differ like host-vs-device already do. Ignored (with a
+    #: warning) when training routes to the host batcher.
+    batch_packing: str = "grid"
 
     def __post_init__(self) -> None:
         self.validate()
@@ -136,6 +146,10 @@ class Word2VecParams:
         )
         _require(self.steps_per_call > 0, "steps_per_call must be > 0")
         _require(self.shared_negatives >= 0, "shared_negatives must be >= 0")
+        _require(
+            self.batch_packing in ("grid", "dense"),
+            "batch_packing must be grid|dense",
+        )
 
     def replace(self, **kwargs) -> "Word2VecParams":
         return dataclasses.replace(self, **kwargs)

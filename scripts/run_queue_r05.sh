@@ -30,6 +30,27 @@ assert float((x @ x).sum()) > 0
 EOF
 }
 
+PROBE_PIDS=()
+
+kill_probes() {
+  # Straggler probes are not harmless (round-5 ADVICE): probes stuck in
+  # device-init against a dead tunnel (up to 4 concurrent, 240s timeout
+  # each) can ALL revive when the tunnel does, then serially grab the
+  # TPU's exclusive process lock just as the measurement stage launches
+  # — the stage dies device-busy, and two such spurious failures park it
+  # as .done. Kill each probe subshell's children (the python holding
+  # the device) then the subshell, and wait so the lock is actually
+  # released before the stage runs.
+  local pid
+  for pid in "${PROBE_PIDS[@]}"; do
+    kill -0 "$pid" 2>/dev/null || continue
+    pkill -TERM -P "$pid" 2>/dev/null
+    kill -TERM "$pid" 2>/dev/null
+  done
+  [ "${#PROBE_PIDS[@]}" -gt 0 ] && wait "${PROBE_PIDS[@]}" 2>/dev/null
+  PROBE_PIDS=()
+}
+
 wait_alive() {
   # Overlapping probes: a single sequential probe blocks up to 240s
   # against a dead tunnel, so a short live window (round 4 saw ~3 min)
@@ -38,16 +59,24 @@ wait_alive() {
   # the flag, so detection lags the chip by ~init time + <=60s. The
   # flag carries a per-call nonce so a stale probe from a PREVIOUS
   # wait_alive can never mark a dead chip alive for the next stage.
+  # Probe PIDs are recorded and the stragglers killed+reaped the moment
+  # the flag lands (and on STOP), so no revived probe can hold the TPU
+  # process lock when the stage starts.
   WAIT_NONCE=$((${WAIT_NONCE:-0} + 1))
   local flag=/tmp/q5_alive_$$_$WAIT_NONCE
   rm -f "$flag"
   until [ -e "$flag" ]; do
-    [ -e "$Q/STOP" ] && return 1
+    if [ -e "$Q/STOP" ]; then
+      kill_probes
+      return 1
+    fi
     ( probe_alive && : > "$flag" ) &
+    PROBE_PIDS+=($!)
     local w=0
     while [ "$w" -lt 60 ] && [ ! -e "$flag" ]; do sleep 5; w=$((w+5)); done
     echo "probe tick $(date -u +%FT%TZ)" >> "$L"
   done
+  kill_probes
   rm -f "$flag"
   echo "chip ALIVE $(date -u +%FT%TZ)" >> "$L"
   return 0

@@ -1,0 +1,448 @@
+"""Dense pair packing for the device-resident train scan (ISSUE 4).
+
+Contracts pinned here:
+  * PAIR-MULTISET PARITY — the packed scan consumes exactly the valid
+    (center, context) pair multiset the grid path trains on, verified
+    three ways against a host-NumPy windowing oracle fed the same shrink
+    draws (the grid position->draw mapping pack_window_pairs reproduces).
+  * MESH INVARIANCE — packed assembly, negative draws (keyed by global
+    pair row), and the resulting tables are identical on every shape of
+    the virtual 8-device mesh, and across the rows/dims layouts.
+  * UPDATE DECOMPOSITION — feeding a grid batch's pairs through the
+    pair-form step applies the identical table update (scatter-adds sum).
+  * LR/ACCOUNTING — the traced consumed-position words_done rule matches
+    the host functions bit-for-bit, and a packed fit lands on the same
+    per-epoch words_done as the grid fit (with and without subsampling).
+  * CHECKPOINT/RESUME — a mid-epoch save carries the consumed-position
+    counter and a resume reproduces the uninterrupted run exactly.
+"""
+
+import json
+import os
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus.batching import context_width, window_offsets
+from glint_word2vec_tpu.ops import sgns
+from glint_word2vec_tpu.ops.device_batching import (
+    corpus_words_done,
+    corpus_words_done_compacted,
+    device_window_batch,
+    device_words_done,
+    grid_window_shrink,
+    pack_window_pairs,
+)
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.utils.params import Word2VecParams
+
+V, D = 97, 16
+
+
+def _corpus(n_sent=7, lens=(5, 1, 9, 3, 12, 2, 6), seed=0):
+    rng = np.random.default_rng(seed)
+    sents = [rng.integers(0, V, L).astype(np.int32) for L in lens[:n_sent]]
+    ids = np.concatenate(sents)
+    offsets = np.zeros(len(sents) + 1, np.int64)
+    np.cumsum([len(s) for s in sents], out=offsets[1:])
+    return ids, offsets, sents
+
+
+def _host_pair_oracle(ids, offsets, b, window):
+    """Host-NumPy ground truth: the valid-pair multiset over the whole
+    corpus given per-position shrink draws ``b`` — pure numpy windowing
+    (offsets in [-b, b-1], in-sentence), no device code."""
+    offs = window_offsets(window)
+    pairs = Counter()
+    for p in range(len(ids)):
+        j = np.searchsorted(offsets, p, side="right") - 1
+        s0, s1 = offsets[j], offsets[j + 1]
+        for o in offs:
+            q = p + o
+            if -b[p] <= o <= b[p] - 1 and s0 <= q < s1:
+                pairs[(int(ids[p]), int(ids[q]))] += 1
+    return pairs
+
+
+def _grid_pair_multiset(ids, offsets, key, window, B):
+    """The pair multiset the GRID corpus scan trains on: step i covers
+    positions [i*B, (i+1)*B) with key fold_in(base, i) — exactly the
+    make_corpus_scan schedule."""
+    N = len(ids)
+    idsj = jnp.asarray(ids)
+    offj = jnp.asarray(offsets, jnp.int32)
+    pairs = Counter()
+    for step, start in enumerate(range(0, N + B, B)):
+        k = jax.random.fold_in(key, np.uint32(step))
+        c, x, m = device_window_batch(
+            idsj, offj, jnp.arange(start, start + B, dtype=jnp.int32),
+            jnp.arange(B, dtype=jnp.int32), k, window,
+        )
+        c, x, m = map(np.asarray, (c, x, m))
+        for i in range(B):
+            for lane in range(x.shape[1]):
+                if m[i, lane] > 0:
+                    pairs[(int(c[i]), int(x[i, lane]))] += 1
+    return pairs
+
+
+def _packed_pair_multiset(ids, offsets, key, window, B, P, span):
+    N = len(ids)
+    idsj = jnp.asarray(ids)
+    offj = jnp.asarray(offsets, jnp.int32)
+    fn = jax.jit(
+        lambda pos: pack_window_pairs(
+            idsj, offj, pos, key, jnp.uint32(0), window=window, span=span,
+            pair_batch=P, grid_batch=B, n_valid=jnp.int32(N),
+        )
+    )
+    pairs = Counter()
+    pos = 0
+    while pos < N:
+        pc, px, pm, n_cons, n_pairs = fn(jnp.int32(pos))
+        assert int(n_cons) >= 1  # guaranteed forward progress
+        assert int(n_pairs) <= P
+        pc, px = np.asarray(pc), np.asarray(px)
+        for j in range(int(n_pairs)):
+            pairs[(int(pc[j]), int(px[j]))] += 1
+        pos += int(n_cons)
+    return pairs
+
+
+@pytest.mark.parametrize("window", [2, 3, 5])
+def test_packed_multiset_matches_grid_and_host_oracle(window):
+    # Three-way: host-NumPy oracle == grid scan pairs == packed pairs,
+    # as exact multisets (centers, contexts, counts). Two packing
+    # geometries so the position cut points differ from the grid batch
+    # boundaries in both directions.
+    ids, offsets, _ = _corpus()
+    key = jax.random.PRNGKey(7)
+    B = 8
+    b = np.asarray(
+        grid_window_shrink(
+            key, jnp.arange(len(ids), dtype=jnp.int32), B, jnp.uint32(0),
+            window,
+        )
+    )
+    oracle = _host_pair_oracle(ids, offsets, b, window)
+    grid = _grid_pair_multiset(ids, offsets, key, window, B)
+    assert grid == oracle
+    C = context_width(window)
+    for P, span in ((16, 12), (max(C, 5), 4)):
+        packed = _packed_pair_multiset(ids, offsets, key, window, B, P, span)
+        assert packed == oracle, (P, span)
+
+
+def test_pack_window_pairs_tail_and_invariants():
+    ids, offsets, _ = _corpus()
+    N = len(ids)
+    key = jax.random.PRNGKey(3)
+    # Past the corpus end: zero pairs, the whole span still consumed
+    # (the epoch tail drains in span-sized strides).
+    pc, px, pm, n_cons, n_pairs = pack_window_pairs(
+        jnp.asarray(ids), jnp.asarray(offsets, jnp.int32),
+        jnp.int32(N + 3), key, jnp.uint32(0),
+        window=3, span=8, pair_batch=16, grid_batch=8,
+        n_valid=jnp.int32(N),
+    )
+    assert int(n_pairs) == 0 and int(n_cons) == 8
+    assert float(np.asarray(pm).sum()) == 0.0
+    assert np.asarray(pc).sum() == 0 and np.asarray(px).sum() == 0
+    # pair_batch below the lane count can deadlock a position: rejected.
+    with pytest.raises(ValueError, match="pair_batch"):
+        pack_window_pairs(
+            jnp.asarray(ids), jnp.asarray(offsets, jnp.int32),
+            jnp.int32(0), key, jnp.uint32(0),
+            window=5, span=8, pair_batch=3, grid_batch=8,
+            n_valid=jnp.int32(N),
+        )
+
+
+def test_device_words_done_matches_host_rules():
+    # The traced rule the packed scan anneals the LR with must equal the
+    # host accounting bit-for-bit: identity stream == corpus_words_done,
+    # compacted stream == corpus_words_done_compacted (emptied sentence
+    # included).
+    ids, offsets, _ = _corpus()
+    N = len(ids)
+    offj = jnp.asarray(offsets, jnp.int32)
+    fn = jax.jit(device_words_done)
+    for end in range(0, N + 4):
+        assert int(
+            fn(offj, offj, jnp.int32(end), jnp.int32(N))
+        ) == corpus_words_done(offsets, end)
+    rng = np.random.default_rng(3)
+    keep = rng.random(N) < 0.5
+    keep[offsets[1] : offsets[2]] = False  # force an emptied sentence
+    kept_before = np.concatenate([[0], np.cumsum(keep.astype(np.int64))])
+    offsets_c = kept_before[offsets]
+    n_kept = int(keep.sum())
+    offcj = jnp.asarray(offsets_c, jnp.int32)
+    for end in range(0, n_kept + 4):
+        assert int(
+            fn(offj, offcj, jnp.int32(end), jnp.int32(n_kept))
+        ) == corpus_words_done_compacted(offsets, offsets_c, end, n_kept)
+
+
+def _mk_engine(shape, seed=11, layout="rows"):
+    counts = np.arange(V, 0, -1).astype(np.int64) * 3
+    return EmbeddingEngine(
+        make_mesh(*shape), V, D, counts, num_negatives=3, seed=seed,
+        layout=layout,
+    )
+
+
+def _run_packed(eng, ids, offsets, key, n_steps=4):
+    eng.upload_corpus(ids, offsets)
+    return eng.train_steps_corpus_packed(
+        0, 16, 3, 8, key, n_steps, step0=2, grid_step0=0,
+        step_size=0.05, total_words=1000, words_base=0,
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (4, 1), (1, 4)])
+def test_packed_scan_mesh_invariance(shape):
+    # Packed assembly is replicated-deterministic and negatives are keyed
+    # by GLOBAL pair row, so tables, pair counts, and position advances
+    # must match the single-device run on every mesh shape.
+    ids, offsets, _ = _corpus()
+    key = jax.random.PRNGKey(5)
+    ref = _mk_engine((1, 1))
+    eng = _mk_engine(shape)
+    r_ref = _run_packed(ref, ids, offsets, key)
+    r_eng = _run_packed(eng, ids, offsets, key)
+    for a, b in zip(r_ref[1:], r_eng[1:]):  # pair_counts, pos_ends, alphas
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for table in ("syn0", "syn1"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(eng, table), np.float32)[:V],
+            np.asarray(getattr(ref, table), np.float32)[:V],
+            rtol=2e-5, atol=1e-7, err_msg=table,
+        )
+
+
+def test_packed_scan_dims_layout_matches_rows():
+    ids, offsets, _ = _corpus()
+    key = jax.random.PRNGKey(5)
+    rows_eng = _mk_engine((2, 2))
+    dims_eng = _mk_engine((2, 2), layout="dims")
+    _run_packed(rows_eng, ids, offsets, key)
+    _run_packed(dims_eng, ids, offsets, key)
+    for table in ("syn0", "syn1"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(dims_eng, table), np.float32)[:V, :D],
+            np.asarray(getattr(rows_eng, table), np.float32)[:V, :D],
+            rtol=2e-5, atol=1e-7, err_msg=table,
+        )
+
+
+def test_packed_scan_validates():
+    ids, offsets, _ = _corpus()
+    eng = _mk_engine((2, 2))
+    with pytest.raises(ValueError, match="no corpus uploaded"):
+        eng.train_steps_corpus_packed(0, 16, 3, 8, jax.random.PRNGKey(0), 1)
+    eng.upload_corpus(ids, offsets)
+    with pytest.raises(ValueError, match="not divisible"):
+        eng.train_steps_corpus_packed(0, 15, 3, 8, jax.random.PRNGKey(0), 1)
+    with pytest.raises(ValueError, match="pair_batch"):
+        eng.train_steps_corpus_packed(0, 2, 5, 8, jax.random.PRNGKey(0), 1)
+
+
+def test_pair_step_decomposes_grid_update(monkeypatch):
+    # Decomposing a grid batch into its pairs and feeding them through
+    # the pair-form step must apply the IDENTICAL table update
+    # (scatter-adds sum; no lane ever contributes twice). Negative draws
+    # are stubbed to a deterministic per-(row, lane) map so both forms
+    # see the same noise words.
+    B, C, n = 6, 3, 2
+
+    def stub_negs(key, prob, alias, rows, shape_per_row):
+        rows = jnp.asarray(rows)
+        k = jnp.arange(n, dtype=jnp.int32)[None, None, :]
+        if shape_per_row[0] == C:  # grid call: rows are batch rows
+            b = rows[:, None, None]
+            c = jnp.arange(C, dtype=jnp.int32)[None, :, None]
+        else:  # pair call: rows are pair rows b*C + c
+            b = (rows // C)[:, None, None]
+            c = (rows % C)[:, None, None]
+        v = (b * 31 + c * 7 + k * 3 + 1) % V
+        return jnp.broadcast_to(
+            v, (rows.shape[0],) + tuple(shape_per_row)
+        ).astype(jnp.int32)
+
+    monkeypatch.setattr(sgns, "sample_negatives_per_row", stub_negs)
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(1)
+    syn0, syn1 = sgns.init_tables(jax.random.PRNGKey(2), V, D)
+    prob = jnp.ones(V, jnp.float32)
+    alias = jnp.arange(V, dtype=jnp.int32)
+    centers = rng.integers(0, V, B).astype(np.int32)
+    contexts = rng.integers(0, V, (B, C)).astype(np.int32)
+    mask = np.ones((B, C), np.float32)
+    alpha = jnp.float32(0.05)
+    g0, g1, gl = sgns.train_step(
+        syn0, syn1, prob, alias, jnp.asarray(centers),
+        jnp.asarray(contexts), jnp.asarray(mask), key, alpha, n,
+    )
+    p0, p1, pl = sgns.train_step_pairs(
+        syn0, syn1, prob, alias,
+        jnp.asarray(np.repeat(centers, C)),
+        jnp.asarray(contexts.reshape(-1)),
+        jnp.ones(B * C, jnp.float32), key, alpha, n,
+    )
+    np.testing.assert_allclose(np.asarray(p0), np.asarray(g0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(g1), rtol=1e-6)
+    np.testing.assert_allclose(float(pl), float(gl), rtol=1e-6)
+
+
+# ---------------- model-level routing, accounting, resume ---------------
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog".split(),
+    "the dog sleeps all day long in the sun".split(),
+    "a quick fox and a lazy dog meet in the field".split(),
+    "the sun rises over the field every day".split(),
+] * 30
+
+
+def _w2v(**kw):
+    from glint_word2vec_tpu import Word2Vec
+
+    defaults = dict(
+        vector_size=12, batch_size=32, min_count=1, num_iterations=2,
+        seed=7, steps_per_call=4, window=3,
+    )
+    defaults.update(kw)
+    return Word2Vec(**defaults)
+
+
+def test_set_batch_packing_validates():
+    from glint_word2vec_tpu import Word2Vec
+
+    with pytest.raises(ValueError, match="batch_packing"):
+        Word2VecParams(batch_packing="loose")
+    w = Word2Vec().set_batch_packing("dense")
+    assert w.params.batch_packing == "dense"
+    # Round-trips through the persisted params metadata.
+    p = Word2VecParams.from_json(w.params.to_json())
+    assert p.batch_packing == "dense"
+    # Old params.json without the field loads with the grid default.
+    blob = json.loads(w.params.to_json())
+    del blob["batch_packing"]
+    assert Word2VecParams.from_json(json.dumps(blob)).batch_packing == "grid"
+
+
+@pytest.mark.parametrize("subsample_ratio", [0.0, 0.01])
+def test_packed_fit_words_done_matches_grid(subsample_ratio):
+    # Same per-epoch pre-subsampling credit on both dispatch shapes: the
+    # LR anneal contract. The packed fit also reports its fill (the
+    # effective mask density of the dense dispatches).
+    m_grid = _w2v(subsample_ratio=subsample_ratio).fit(CORPUS)
+    m_dense = _w2v(
+        subsample_ratio=subsample_ratio, batch_packing="dense"
+    ).fit(CORPUS)
+    assert m_grid.training_metrics["pipeline"] == "device_corpus"
+    assert m_dense.training_metrics["pipeline"] == "device_corpus"
+    assert (
+        m_dense.training_metrics["words_done"]
+        == m_grid.training_metrics["words_done"]
+    )
+    assert m_dense.training_metrics["batch_packing"] == "dense"
+    assert m_dense.training_metrics["packed_mask_density"] >= 0.9
+    # The packed model still learns a queryable table.
+    assert len(m_dense.find_synonyms("quick", 3)) == 3
+
+
+def test_packed_fit_checkpoint_resume_mid_epoch(tmp_path, monkeypatch):
+    # Preemption drill ON THE FULL 8-DEVICE MESH (2 data x 4 model): stop
+    # after 3 dispatch groups (mid-epoch), assert the state file carries
+    # a nonzero consumed-position counter, then resume and match the
+    # uninterrupted run's tables exactly — the position/gstep restore
+    # makes every subsequent dispatch identical.
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck, exist_ok=True)
+    mesh = make_mesh(2, 4)
+    monkeypatch.setenv("GLINT_PACKED_STOP_AFTER_GROUPS", "3")
+    _w2v(batch_packing="dense", mesh=mesh).fit(CORPUS, checkpoint_dir=ck)
+    monkeypatch.delenv("GLINT_PACKED_STOP_AFTER_GROUPS")
+    state = json.load(open(os.path.join(ck, "train_state.json")))
+    assert state["position"] > 0, state
+    assert state["epochs_completed"] == 0, state
+    m_resumed = _w2v(batch_packing="dense", mesh=mesh).fit(
+        CORPUS, checkpoint_dir=ck
+    )
+    m_full = _w2v(batch_packing="dense", mesh=mesh).fit(CORPUS)
+    np.testing.assert_array_equal(
+        np.asarray(m_resumed.engine.syn0, np.float32),
+        np.asarray(m_full.engine.syn0, np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m_resumed.engine.syn1, np.float32),
+        np.asarray(m_full.engine.syn1, np.float32),
+    )
+    final = json.load(open(os.path.join(ck, "train_state.json")))
+    assert final["epochs_completed"] == 2 and final["position"] == 0
+
+
+def test_packed_fit_boundary_checkpoint_resume(tmp_path):
+    # Epoch-boundary save/resume (the existing grid contract) under
+    # packing: the resumed run completes and serves queries.
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck, exist_ok=True)
+    m1 = _w2v(num_iterations=3, batch_packing="dense").fit(
+        CORPUS, checkpoint_dir=ck, stop_after_epochs=1
+    )
+    assert m1.training_metrics["pipeline"] == "device_corpus"
+    state = json.load(open(os.path.join(ck, "train_state.json")))
+    assert state["epochs_completed"] == 1 and state["position"] == 0
+    m2 = _w2v(num_iterations=3, batch_packing="dense").fit(
+        CORPUS, checkpoint_dir=ck
+    )
+    assert m2.training_metrics["steps"] > 0
+    assert len(m2.find_synonyms("dog", 2)) == 2
+
+
+def test_mid_epoch_state_refuses_cross_mode_resume(tmp_path, monkeypatch):
+    # A mid-epoch packed state resumed in grid mode would silently drop
+    # the consumed-position counter and re-train the epoch's consumed
+    # prefix; the loop must refuse instead. Epoch-BOUNDARY packed states
+    # (position 0) stay resumable from either mode.
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck, exist_ok=True)
+    monkeypatch.setenv("GLINT_PACKED_STOP_AFTER_GROUPS", "2")
+    _w2v(batch_packing="dense").fit(CORPUS, checkpoint_dir=ck)
+    monkeypatch.delenv("GLINT_PACKED_STOP_AFTER_GROUPS")
+    assert json.load(open(os.path.join(ck, "train_state.json")))["position"] > 0
+    with pytest.raises(ValueError, match="batch_packing"):
+        _w2v().fit(CORPUS, checkpoint_dir=ck)
+    ck2 = str(tmp_path / "ck2")
+    os.makedirs(ck2, exist_ok=True)
+    _w2v(num_iterations=2, batch_packing="dense").fit(
+        CORPUS, checkpoint_dir=ck2, stop_after_epochs=1
+    )
+    m = _w2v(num_iterations=2).fit(CORPUS, checkpoint_dir=ck2)
+    assert m.training_metrics["pipeline"] == "device_corpus"
+
+
+def test_packed_subsampled_checkpoint_resume(tmp_path, monkeypatch):
+    # Mid-epoch resume with subsampling: the epoch recompacts from
+    # (seed, epoch) alone, so the restored position indexes the identical
+    # compacted stream.
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck, exist_ok=True)
+    kw = dict(batch_packing="dense", subsample_ratio=0.01)
+    monkeypatch.setenv("GLINT_PACKED_STOP_AFTER_GROUPS", "2")
+    _w2v(**kw).fit(CORPUS, checkpoint_dir=ck)
+    monkeypatch.delenv("GLINT_PACKED_STOP_AFTER_GROUPS")
+    state = json.load(open(os.path.join(ck, "train_state.json")))
+    assert state["position"] > 0
+    m_resumed = _w2v(**kw).fit(CORPUS, checkpoint_dir=ck)
+    m_full = _w2v(**kw).fit(CORPUS)
+    np.testing.assert_array_equal(
+        np.asarray(m_resumed.engine.syn0, np.float32),
+        np.asarray(m_full.engine.syn0, np.float32),
+    )
