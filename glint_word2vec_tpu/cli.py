@@ -57,6 +57,13 @@ def _add_train(sub):
                         "(0 = per-pair reference semantics)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="epochs between checkpoints (default 1). Saves "
+                        "are asynchronous by default — the fit thread "
+                        "blocks only for the device->host snapshot "
+                        "copy; write + commit run on a background "
+                        "thread (GLINT_SYNC_CKPT=1 forces blocking "
+                        "saves)")
     p.add_argument("--metrics-out", default=None,
                    help="write training metrics JSON here (atomic write)")
     obs = p.add_argument_group(
@@ -245,6 +252,7 @@ def _run(args) -> int:
         model = w2v.fit_file(
             args.corpus, lowercase=args.lowercase,
             checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every_epochs=args.checkpoint_every,
         )
         model.save(args.output)
         print(json.dumps({"saved": args.output, **(model.training_metrics or {})}))

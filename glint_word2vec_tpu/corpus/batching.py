@@ -143,6 +143,77 @@ class Batch:
     words_done: int  # cumulative trained-word count (drives LR anneal)
 
 
+@dataclass
+class BatchGroup:
+    """One dispatch group: ``group_size`` minibatches stacked to the
+    on-device scan's ``(K, ...)`` shape, tail-padded with zero-mask rows
+    so the jitted scan never sees a second K. Produced off the training
+    thread (see :func:`group_batches`) so the stacking cost overlaps
+    device compute instead of serializing dispatches (ISSUE 5)."""
+
+    centers: np.ndarray  # (K, B) int32
+    contexts: np.ndarray  # (K, B, C) int32
+    mask: np.ndarray  # (K, B, C) float32
+    words_done: List[int]  # per-slot cumulative count (pad repeats last)
+    n_real: int  # live minibatches; slots [n_real, K) are zero-mask pad
+
+    def __len__(self) -> int:
+        return int(self.centers.shape[0])
+
+
+def group_batches(
+    batches: Iterator[Batch], group_size: int
+) -> Iterator[BatchGroup]:
+    """Collect ``group_size`` minibatches at a time and stack them into
+    the dispatch-ready :class:`BatchGroup` form.
+
+    This is the per-group host assembly the fit loop used to run inline
+    between dispatches; yielding it from a generator lets
+    ``utils.prefetch`` move the whole thing (windowing + stacking +
+    padding) onto the producer thread — a bounded depth-2 pipeline that
+    keeps batch production overlapped with device execution. Each
+    group's assembly is recorded as a ``batch_prefetch`` span (on the
+    producer thread's tid) when observability is on."""
+    from glint_word2vec_tpu.obs import events as obs_events
+
+    K = int(group_size)
+    if K <= 0:
+        raise ValueError("group_size must be > 0")
+    g = 0
+    while True:
+        with obs_events.span("batch_prefetch", group=g):
+            group: List[Batch] = []
+            for batch in batches:
+                group.append(batch)
+                if len(group) == K:
+                    break
+            if not group:
+                return
+            n_real = len(group)
+            if n_real < K:
+                # Epoch-tail pad: zero-mask rows update nothing; the pad
+                # slots inherit the last live words_done so the LR
+                # schedule inputs stay well-defined (they are never
+                # recorded — n_real excludes them).
+                proto = group[0]
+                pad = Batch(
+                    centers=np.zeros_like(proto.centers),
+                    contexts=np.zeros_like(proto.contexts),
+                    mask=np.zeros_like(proto.mask),
+                    words_done=group[-1].words_done,
+                )
+                group.extend([pad] * (K - n_real))
+            out = BatchGroup(
+                centers=np.stack([b.centers for b in group]),
+                contexts=np.stack([b.contexts for b in group]),
+                mask=np.stack([b.mask for b in group]),
+                words_done=[b.words_done for b in group],
+                n_real=n_real,
+            )
+        yield out
+        g += 1
+
+
 class SkipGramBatcher:
     """Streams fixed-shape minibatches from an encoded corpus.
 

@@ -98,21 +98,22 @@ class FastTextWord2Vec(Word2Vec):
             layout=p.layout,
         )
 
-    def _train_batches(self, engine, batches, base_key, step0, alphas):
+    def _train_batches(self, engine, group, base_key, step0, alphas):
         # Host-side expansion of center words to their subword groups;
         # padded batch rows (center 0) carry zero context masks, so their
         # group updates are zeroed by the gradient coefficients. The
         # expansion is this family's extra host-side phase, so it gets
-        # its own span inside the fit loop's device_steps window.
+        # its own span inside the fit loop's device_steps window. The
+        # batch stacking itself already happened on the producer thread
+        # (the group arrives as a pre-stacked BatchGroup).
         with obs_events.span("subword_expand", step0=step0):
-            centers_k = np.stack([b.centers for b in batches])
-            groups = self._sub_ids[centers_k]
-            gmask = self._sub_mask[centers_k]
+            groups = self._sub_ids[group.centers]
+            gmask = self._sub_mask[group.centers]
         return engine.train_steps_grouped(
             groups,
             gmask,
-            np.stack([b.contexts for b in batches]),
-            np.stack([b.mask for b in batches]),
+            group.contexts,
+            group.mask,
             base_key,
             alphas,
             step0,

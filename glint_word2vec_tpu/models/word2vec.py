@@ -20,19 +20,22 @@ per-partition async future chains (mllib:417-429).
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
+import time
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from glint_word2vec_tpu.corpus.batching import (
-    Batch,
+    BatchGroup,
     SkipGramBatcher,
     chunk_sentences,
     context_width,
     encode_sentences,
+    group_batches,
 )
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
 from glint_word2vec_tpu.obs import TrainingDiverged, start_run
@@ -80,6 +83,34 @@ def _flip_checkpoint_state(
             shutil.rmtree(
                 os.path.join(checkpoint_dir, entry), ignore_errors=True
             )
+
+
+def _checkpoint_tables(
+    engine, obs_run, metrics, ck_path: str, ck_name: str, commit
+) -> None:
+    """Write one checkpoint without stalling the dispatch pipeline.
+
+    Default (single-process): ``engine.save_async`` — the calling thread
+    blocks only for the device->host snapshot copy (``ckpt_snapshot``
+    span) and returns to dispatching; serialization, durability fsyncs,
+    the atomic directory commit, and the ``commit`` callback (the
+    ``train_state.json`` flip) all run on the engine's single writer
+    thread (``ckpt_write`` span), strictly in that order, so a crash at
+    any point leaves the previous committed checkpoint authoritative.
+    ``GLINT_SYNC_CKPT=1`` (or multi-process) forces the fully blocking
+    path. Either way the call-site duration is charged to the
+    ``device_stall_seconds`` proxy — the wall-clock pause ``bench.py
+    stall_overlap`` measures (async removes the write/fsync share of
+    it, >80% at the benched config)."""
+    t0 = time.time()
+    if engine.async_saves_enabled():
+        with obs_run.span("ckpt_snapshot", ckpt=ck_name):
+            engine.save_async(ck_path, on_commit=commit)
+    else:
+        with obs_run.span("checkpoint_save", ckpt=ck_name):
+            engine.save(ck_path)
+            commit()
+    metrics.record_stall(time.time() - t0)
 
 
 def _save_diverged_snapshot(engine, checkpoint_dir, obs_run) -> None:
@@ -526,6 +557,88 @@ class Word2Vec:
                 )
             )
             obs_run.attach_metrics(metrics)
+            # Mutated by _harvest_packed (declared before the epoch loop
+            # so the closure binds the method scope, not a loop body).
+            n_pos, offsets_c, epoch, epoch_wd = N, None, start_epoch, 0
+
+            def _prefetch_next_compact(next_epoch: int) -> None:
+                # ISSUE 5 prefetch overlap: DISPATCH (don't adopt) the
+                # next epoch's subsample-compact pass while the current
+                # epoch's tail group is still executing; the next
+                # compact_corpus call adopts the bitwise-identical
+                # buffers without re-running the pass. Skipped when the
+                # run won't reach that epoch (the transient buffer would
+                # just burn HBM). GLINT_NO_COMPACT_PREFETCH=1 restores
+                # the serialized epoch boundary (debug escape hatch).
+                if not subsampling or next_epoch >= p.num_iterations:
+                    return
+                if (
+                    stop_after_epochs is not None
+                    and (next_epoch - start_epoch) >= stop_after_epochs
+                ):
+                    return
+                if os.environ.get("GLINT_NO_COMPACT_PREFETCH", "0") == "1":
+                    return
+                with obs_run.span("subsample_prefetch", epoch=next_epoch):
+                    engine.prefetch_compact_corpus(
+                        jax.random.fold_in(base_key, next_epoch)
+                    )
+
+            def _harvest_packed(pend) -> int:
+                # Convert ONE dispatched packed group's result scalars
+                # and fold them into the step/LR/canary accounting;
+                # returns the group's final consumed position. Under the
+                # deferred schedule the NEXT group is already dispatched
+                # when this blocks, so the device never idles behind the
+                # conversion — and the metric/canary view lags the
+                # device by exactly one dispatch group (documented;
+                # tests/test_stall.py pins it). A group dispatched
+                # entirely past the corpus end (the deferred schedule's
+                # one possible phantom tail group) records nothing and
+                # does NOT advance the step counter: its steps were all
+                # zero-pair no-ops, and the epoch-end ``dstep = step``
+                # reset drops its fold_in keys so the next epoch's key
+                # schedule matches the synchronous loop bitwise.
+                nonlocal step, epoch_wd
+                nonlocal packed_pairs, packed_slots, packed_groups
+                losses, pair_counts, pos_ends, alphas_d, start_h = pend
+                with metrics.timing("step"), obs_run.span(
+                    "readback_harvest", packed=True
+                ) as hspan:
+                    pos_ends_h = np.asarray(pos_ends)
+                    pairs_h = np.asarray(pair_counts)
+                    alphas_h = np.asarray(alphas_d)
+                    starts = np.concatenate(([start_h], pos_ends_h[:-1]))
+                    # Live steps form a prefix: positions only ever
+                    # advance, so the first start past the corpus end
+                    # makes all later steps no-ops.
+                    n_real = int((starts < n_pos).sum())
+                    hspan.update(n=n_real)
+                    for i in range(n_real):
+                        step += 1
+                        end_pos = int(min(pos_ends_h[i], n_pos))
+                        if subsampling:
+                            done = corpus_words_done_compacted(
+                                offsets, offsets_c, end_pos, n_pos
+                            )
+                        else:
+                            done = corpus_words_done(offsets, end_pos)
+                        epoch_wd = epoch * twc + done
+                        metrics.record_step(
+                            int(epoch_wd), loss=losses[i],
+                            alpha=float(alphas_h[i]),
+                        )
+                    obs_run.observe_losses(step - n_real, losses, n_real)
+                if n_real:
+                    obs_run.update(
+                        step=step, words_done=int(epoch_wd),
+                        alpha=float(alphas_h[n_real - 1]),
+                    )
+                    step += spc - n_real  # tail no-ops consumed keys
+                packed_pairs += int(pairs_h[:n_real].sum())
+                packed_slots += n_real * pair_batch
+                packed_groups += 1
+                return int(pos_ends_h[-1])
 
             for epoch in range(start_epoch, p.num_iterations):
                 obs_run.update(epoch=epoch)
@@ -534,9 +647,11 @@ class Word2Vec:
                     # (the reference reseeds per iteration, mllib:371-373),
                     # so a resumed run recompacts epoch e to the identical
                     # buffers — no compaction state needs checkpointing.
-                    with metrics.timing("step"), obs_run.span(
-                        "subsample_compact", epoch=epoch
-                    ):
+                    # The blocking n_kept sync is charged to the stall
+                    # proxy; with the pass prefetched during the previous
+                    # epoch's tail it is near zero.
+                    with metrics.timing("step"), metrics.stall_timing(), \
+                            obs_run.span("subsample_compact", epoch=epoch):
                         n_pos = engine.compact_corpus(
                             jax.random.fold_in(base_key, epoch)
                         )
@@ -549,83 +664,81 @@ class Word2Vec:
                     pos = resume_position
                     resume_position = 0
                     epoch_wd = epoch * twc
+                    # Deferred readbacks (ISSUE 5): the dispatch of group
+                    # g+1 chains on group g's final position as a DEVICE
+                    # scalar (no host sync), and group g's scalars are
+                    # harvested while g+1 executes — the per-group host
+                    # conversion stops serializing the device. Identical
+                    # dispatch arguments to the synchronous schedule
+                    # except one possible zero-pair phantom tail group
+                    # per epoch (rolled out of the key schedule at epoch
+                    # end), so tables are bitwise-identical either way
+                    # (tests/test_stall.py). GLINT_SYNC_READBACK=1 — and
+                    # the stop-after-groups drill, which must know each
+                    # group's end position before deciding to dispatch —
+                    # force the synchronous schedule.
+                    defer = (
+                        stop_after_groups is None
+                        and os.environ.get("GLINT_SYNC_READBACK", "0")
+                        != "1"
+                    )
+                    pending = None
+                    next_start = pos  # host int now, device scalar later
+                    dstep = step  # dispatch-time step0 (runs ahead)
                     while pos < n_pos:
                         with metrics.timing("step"), obs_run.span(
-                            "device_steps", step0=step, n=spc, packed=True
-                        ) as dspan:
+                            "device_steps", step0=dstep, n=spc, packed=True
+                        ):
                             (
                                 losses, pair_counts, pos_ends, alphas_d,
                             ) = engine.train_steps_corpus_packed(
-                                pos, pair_batch, p.window, B, base_key,
-                                spc, step0=step, grid_step0=gstep,
-                                step_size=p.step_size,
+                                next_start, pair_batch, p.window, B,
+                                base_key, spc, step0=dstep,
+                                grid_step0=gstep, step_size=p.step_size,
                                 total_words=total_words,
                                 words_base=epoch * twc,
                             )
-                            # One (K,)-scalar readback per dispatch: the
-                            # data-dependent position advance the next
-                            # group starts from (and the per-step
-                            # accounting metrics record).
-                            pos_ends_h = np.asarray(pos_ends)
-                            pairs_h = np.asarray(pair_counts)
-                            alphas_h = np.asarray(alphas_d)
-                            starts = np.concatenate(
-                                ([pos], pos_ends_h[:-1])
-                            )
-                            # Live steps form a prefix: positions only
-                            # ever advance, so the first start past the
-                            # corpus end makes all later steps no-ops.
-                            n_real = int((starts < n_pos).sum())
-                            # The live count is only known after the
-                            # readback; amend the span so event-log
-                            # consumers see the same n semantics as the
-                            # grid path (n = live steps, not spc).
-                            dspan.update(n=n_real)
-                            for i in range(n_real):
-                                step += 1
-                                end_pos = int(min(pos_ends_h[i], n_pos))
-                                if subsampling:
-                                    done = corpus_words_done_compacted(
-                                        offsets, offsets_c, end_pos, n_pos
-                                    )
-                                else:
-                                    done = corpus_words_done(
-                                        offsets, end_pos
-                                    )
-                                epoch_wd = epoch * twc + done
-                                metrics.record_step(
-                                    int(epoch_wd), loss=losses[i],
-                                    alpha=float(alphas_h[i]),
-                                )
-                            obs_run.observe_losses(
-                                step - n_real, losses, n_real
-                            )
-                        if n_real:
-                            obs_run.update(
-                                step=step, words_done=int(epoch_wd),
-                                alpha=float(alphas_h[n_real - 1]),
-                            )
-                        step += spc - n_real  # tail no-ops consumed keys
-                        packed_pairs += int(pairs_h[:n_real].sum())
-                        packed_slots += n_real * pair_batch
-                        pos = int(pos_ends_h[-1])
-                        packed_groups += 1
-                        if (
-                            stop_after_groups is not None
-                            and packed_groups >= stop_after_groups
-                        ):
-                            early_stop = True
-                            break
+                        dstep += spc
+                        next_start = pos_ends[-1]  # device scalar chain
+                        new_pend = [
+                            losses, pair_counts, pos_ends, alphas_d, pos,
+                        ]
+                        if pending is not None:
+                            # Harvest g-1 while g runs; its end position
+                            # is g's true start for the live-step count.
+                            pos = _harvest_packed(pending)
+                            new_pend[4] = pos
+                        pending = new_pend
+                        if not defer:
+                            pos = _harvest_packed(pending)
+                            pending = None
+                            next_start = pos
+                            if (
+                                stop_after_groups is not None
+                                and packed_groups >= stop_after_groups
+                            ):
+                                early_stop = True
+                                break
+                    if not early_stop:
+                        # Enqueue the next epoch's compaction BEFORE
+                        # draining: it lands behind the tail group in the
+                        # device queue and runs while the host drains.
+                        _prefetch_next_compact(epoch + 1)
+                    if pending is not None:
+                        pos = _harvest_packed(pending)
+                        pending = None
+                    # Drop the phantom tail group's keys (if any) so the
+                    # next epoch's step0 matches the synchronous loop.
+                    dstep = step
                     if early_stop:
                         if state_path:
                             ck_name = f"ckpt-e{epoch}-p{pos}"
-                            with obs_run.span(
-                                "checkpoint_save", ckpt=ck_name
-                            ):
-                                engine.save(
-                                    os.path.join(checkpoint_dir, ck_name)
-                                )
-                                _flip_checkpoint_state(
+                            _checkpoint_tables(
+                                engine, obs_run, metrics,
+                                os.path.join(checkpoint_dir, ck_name),
+                                ck_name,
+                                functools.partial(
+                                    _flip_checkpoint_state,
                                     checkpoint_dir, state_path, ck_name,
                                     epochs_completed=epoch, step=step,
                                     words_done=int(epoch_wd),
@@ -633,7 +746,8 @@ class Word2Vec:
                                         "position": pos, "gstep": gstep,
                                         "batch_packing": "dense",
                                     },
-                                )
+                                ),
+                            )
                         logger.info(
                             "stopping mid-epoch %d at position %d "
                             "(GLINT_PACKED_STOP_AFTER_GROUPS)", epoch, pos,
@@ -705,6 +819,10 @@ class Word2Vec:
                             )
                         step += spc - n_real  # tail no-ops consumed keys
                     gstep = step
+                    # Grid dispatches are asynchronous: the tail group is
+                    # still executing here, so the next epoch's
+                    # compaction queues right behind it.
+                    _prefetch_next_compact(epoch + 1)
                 stopping = (
                     stop_after_epochs is not None
                     and (epoch + 1 - start_epoch) >= stop_after_epochs
@@ -714,10 +832,12 @@ class Word2Vec:
                     or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
                 ):
                     ck_name = f"ckpt-{epoch + 1}"
-                    with obs_run.span("checkpoint_save", ckpt=ck_name):
-                        engine.save(os.path.join(checkpoint_dir, ck_name))
-                        _flip_checkpoint_state(
-                            checkpoint_dir, state_path, ck_name,
+                    _checkpoint_tables(
+                        engine, obs_run, metrics,
+                        os.path.join(checkpoint_dir, ck_name), ck_name,
+                        functools.partial(
+                            _flip_checkpoint_state, checkpoint_dir,
+                            state_path, ck_name,
                             epochs_completed=epoch + 1, step=step,
                             words_done=(epoch + 1) * twc,
                             extra=(
@@ -727,14 +847,21 @@ class Word2Vec:
                                 }
                                 if packed else None
                             ),
-                        )
+                        ),
+                    )
                 if stopping:
                     logger.info("stopping early after epoch %d", epoch + 1)
                     break
+            # Fit-exit barrier: the fit must not return (and the model
+            # must not be saved over) while a snapshot write is in
+            # flight; a failed async write surfaces HERE, loudly.
+            engine.wait_pending_saves()
         except TrainingDiverged:
+            engine.wait_pending_saves(reraise=False)
             _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
             raise
         except BaseException:
+            engine.wait_pending_saves(reraise=False)
             obs_run.close(failed=True)
             raise
         finally:
@@ -899,12 +1026,37 @@ class Word2Vec:
                 # first; state.json (atomic rename) flips to it last, so a crash
                 # mid-write can never yield a state file pointing at mismatched
                 # or partial tables. Older snapshot dirs are pruned after.
+                # Single-process: the whole sequence runs on the engine's
+                # background writer thread (non-blocking checkpointing,
+                # ISSUE 5) — the fit loop keeps dispatching.
                 # Multi-host: every process writes its own table shards
-                # (engine.save), then a barrier ensures all shards are on disk
-                # before process 0 alone flips state.json and prunes — per-host
-                # counters can diverge only by padding, and a lone writer keeps
-                # the flip atomic.
+                # (engine.save, blocking — the barrier needs them on
+                # disk), then a barrier ensures all shards are written
+                # before process 0 alone flips state.json and prunes —
+                # per-host counters can diverge only by padding, and a
+                # lone writer keeps the flip atomic.
                 ck_name = f"ckpt-{epochs_completed}"
+                # words_done feeds the resumed run's metrics base and the
+                # single-host LR accounting; under the multi-host schedule
+                # the global pro-rata count is the coherent value (the
+                # local batcher count is per-shard and would mix units).
+                wd = (
+                    batcher.words_done
+                    if steps_per_epoch is None
+                    else epochs_completed * vocab.train_words_count
+                )
+                if pc == 1:
+                    _checkpoint_tables(
+                        engine, obs_run, metrics,
+                        os.path.join(checkpoint_dir, ck_name), ck_name,
+                        functools.partial(
+                            _flip_checkpoint_state, checkpoint_dir,
+                            state_path, ck_name,
+                            epochs_completed=epochs_completed, step=step,
+                            words_done=wd,
+                        ),
+                    )
+                    return
                 with obs_run.span("checkpoint_save", ckpt=ck_name):
                     engine.save(os.path.join(checkpoint_dir, ck_name))
                 if pc > 1:
@@ -914,15 +1066,6 @@ class Word2Vec:
                         f"glint_w2v_ckpt_{epochs_completed}"
                     )
                 if jax.process_index() == 0:
-                    # words_done feeds the resumed run's metrics base and the
-                    # single-host LR accounting; under the multi-host schedule
-                    # the global pro-rata count is the coherent value (the local
-                    # batcher count is per-shard and would mix units).
-                    wd = (
-                        batcher.words_done
-                        if steps_per_epoch is None
-                        else epochs_completed * vocab.train_words_count
-                    )
                     _flip_checkpoint_state(
                         checkpoint_dir, state_path, ck_name,
                         epochs_completed=epochs_completed, step=step,
@@ -944,15 +1087,43 @@ class Word2Vec:
                 else max(1, -(-steps_per_epoch // spc))
             )
 
-            def _zero_batch() -> Batch:
-                from glint_word2vec_tpu.corpus.batching import context_width
-
+            def _zero_group() -> BatchGroup:
+                # Lockstep padding group: exactly spc zero-mask batches
+                # (the scan length every host dispatches) so batch
+                # stacks, alphas, and PRNG key advancement stay in
+                # multi-host lockstep; excluded from metrics (n_real=0).
                 B, C = batcher.batch_size, context_width(batcher.window)
-                return Batch(
-                    centers=np.zeros(B, np.int32),
-                    contexts=np.zeros((B, C), np.int32),
-                    mask=np.zeros((B, C), np.float32),
-                    words_done=batcher.words_done,
+                return BatchGroup(
+                    centers=np.zeros((spc, B), np.int32),
+                    contexts=np.zeros((spc, B, C), np.int32),
+                    mask=np.zeros((spc, B, C), np.float32),
+                    words_done=[batcher.words_done] * spc,
+                    n_real=0,
+                )
+
+            def _harvest_host(pend) -> None:
+                # Deferred loss sync (ISSUE 5): group g's records and
+                # canary check run after group g+1 is dispatched, so the
+                # periodic loss sync they force waits on a device that
+                # already has the next group queued behind it — the
+                # metric/canary view lags the device by exactly one
+                # dispatch group. The dispatch schedule itself is
+                # untouched (records only), so tables are unaffected.
+                losses, wds_l, alphas_l, n_real, step_base = pend
+                if not n_real:
+                    return
+                with metrics.timing("step"), obs_run.span(
+                    "readback_harvest", step0=step_base, n=n_real
+                ):
+                    for i in range(n_real):
+                        metrics.record_step(
+                            wds_l[i], loss=losses[i], alpha=alphas_l[i]
+                        )
+                    obs_run.observe_losses(step_base, losses, n_real)
+                obs_run.update(
+                    step=step_base + n_real,
+                    words_done=int(wds_l[n_real - 1]),
+                    alpha=float(alphas_l[n_real - 1]),
                 )
 
             def _sched_alpha(idx_in_epoch: int, epoch: int) -> tuple:
@@ -971,14 +1142,19 @@ class Word2Vec:
 
             for epoch in range(start_epoch, p.num_iterations):
                 obs_run.update(epoch=epoch)
-                # Double-buffered infeed: batches are produced on a
-                # background thread while the device executes
-                # (utils/prefetch.py), then dispatched ``steps_per_call``
-                # at a time as one on-device scan
-                # (EmbeddingEngine.train_steps) — one host round-trip per
-                # group.
-                it = prefetch(batcher.epoch(epoch), depth=2 * spc)
+                # Group-granular producer pipeline: windowing, batch
+                # stacking, and tail padding ALL run on a background
+                # thread (corpus/batching.group_batches under
+                # utils/prefetch, depth 2 dispatch groups), so the
+                # training thread's per-group host work collapses to one
+                # queue pop + the LR schedule. The pop's wait time is
+                # charged to the device_stall_seconds proxy — if the
+                # producer falls behind the device, it shows up there.
+                it = prefetch(
+                    group_batches(batcher.epoch(epoch), spc), depth=2
+                )
                 g = 0
+                pending = None  # previous group's deferred loss records
                 while True:
                     if forced_groups is not None and g >= forced_groups:
                         if next(it, None) is not None:
@@ -987,52 +1163,29 @@ class Word2Vec:
                                 "batches than the agreed per-epoch step count"
                             )
                         break
-                    group = []
-                    with metrics.timing("host"), obs_run.span(
-                        "host_batch", epoch=epoch, group=g
-                    ):
-                        while len(group) < spc:
-                            batch = next(it, None)
-                            if batch is None:
-                                break
-                            group.append(batch)
+                    with metrics.timing("host"), metrics.stall_timing(), \
+                            obs_run.span("host_batch", epoch=epoch,
+                                         group=g):
+                        grp = next(it, None)
                     pad_only = False
-                    if not group:
+                    if grp is None:
                         if forced_groups is None:
                             break
-                        # Lockstep padding: this host's shard is exhausted
-                        # but other hosts still have batches — keep
-                        # dispatching zero-mask groups up to the agreed
-                        # count. Exactly spc batches (the scan length every
-                        # host dispatches) so batch stacks, alphas, and
-                        # PRNG key advancement stay in lockstep; excluded
-                        # from metrics (n_real=0) so no-op steps don't
-                        # deflate loss curves.
-                        group = [_zero_batch()] * spc
+                        # This host's shard is exhausted but other hosts
+                        # still have batches — keep dispatching zero-mask
+                        # groups up to the agreed count (see _zero_group).
+                        grp = _zero_group()
                         pad_only = True
-                    n_real = 0 if pad_only else len(group)
-                    if not pad_only and n_real < spc:
-                        # Pad the epoch-tail group to the full scan length
-                        # so the jitted scan never sees a second K (XLA
-                        # compiles are expensive). Zero-mask batches update
-                        # nothing.
-                        proto = group[0]
-                        pad = Batch(
-                            centers=np.zeros_like(proto.centers),
-                            contexts=np.zeros_like(proto.contexts),
-                            mask=np.zeros_like(proto.mask),
-                            words_done=group[-1].words_done,
-                        )
-                        group.extend([pad] * (spc - n_real))
+                    n_real = 0 if pad_only else grp.n_real
                     if steps_per_epoch is None:
+                        wds = list(grp.words_done)
                         alphas = [
                             max(
-                                p.step_size * (1 - b.words_done / total_words),
+                                p.step_size * (1 - wd / total_words),
                                 p.step_size * 1e-4,
                             )
-                            for b in group
+                            for wd in wds
                         ]
-                        wds = [b.words_done for b in group]
                     else:
                         sched = [
                             _sched_alpha(g * spc + j, epoch)
@@ -1040,34 +1193,26 @@ class Word2Vec:
                         ]
                         alphas = [a for a, _ in sched]
                         wds = [w for _, w in sched]
-                    # The whole device interaction counts as "step" time:
-                    # the dispatch AND the loss reads (record_step syncs on
-                    # the device every log_every steps — with async
-                    # dispatch that wait IS the device time, and leaving it
-                    # outside both buckets made host_frac meaningless).
                     with metrics.timing("step"), obs_run.span(
                         "device_steps", step0=step, n=n_real
                     ):
                         losses = self._train_batches(
-                            engine, group, base_key, step,
+                            engine, grp, base_key, step,
                             np.asarray(alphas, np.float32),
                         )
-                        for i in range(n_real):
-                            step += 1
-                            metrics.record_step(
-                                wds[i], loss=losses[i], alpha=alphas[i]
-                            )
-                        # Inside the step bucket: the canary's periodic
-                        # loss sync waits on the device, and device waits
-                        # outside both buckets would skew host_frac.
-                        obs_run.observe_losses(step - n_real, losses, n_real)
-                    if n_real:
-                        obs_run.update(
-                            step=step, words_done=int(wds[n_real - 1]),
-                            alpha=float(alphas[n_real - 1]),
-                        )
-                    step += len(group) - n_real  # padded steps used keys too
+                    new_pend = (losses, wds, alphas, n_real, step)
+                    step += spc  # pad/tail steps consumed keys too
+                    # Harvest group g-1's records while group g runs
+                    # (one-group deferred loss sync, see _harvest_host).
+                    if pending is not None:
+                        _harvest_host(pending)
+                    pending = new_pend
                     g += 1
+                if pending is not None:
+                    # Epoch-end drain: metrics/canary catch up before the
+                    # checkpoint reads words_done.
+                    _harvest_host(pending)
+                    pending = None
                 stopping = (
                     stop_after_epochs is not None
                     and (epoch + 1 - start_epoch) >= stop_after_epochs
@@ -1080,10 +1225,15 @@ class Word2Vec:
                 if stopping:
                     logger.info("stopping early after epoch %d", epoch + 1)
                     break
+            # Fit-exit barrier for in-flight async checkpoint writes
+            # (failed writes surface here, loudly).
+            engine.wait_pending_saves()
         except TrainingDiverged:
+            engine.wait_pending_saves(reraise=False)
             _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
             raise
         except BaseException:
+            engine.wait_pending_saves(reraise=False)
             obs_run.close(failed=True)
             raise
         finally:
@@ -1114,15 +1264,14 @@ class Word2Vec:
             layout=p.layout,
         )
 
-    def _train_batches(self, engine, batches, base_key, step0, alphas):
-        """Dispatch a group of batches as one on-device scan; returns the
-        per-batch losses (lazy device array)."""
+    def _train_batches(self, engine, group: BatchGroup, base_key, step0,
+                       alphas):
+        """Dispatch one pre-stacked :class:`BatchGroup` as one on-device
+        scan; returns the per-batch losses (lazy device array). The
+        stacking itself happens on the producer thread
+        (corpus/batching.group_batches) so this hook is dispatch-only."""
         return engine.train_steps(
-            np.stack([b.centers for b in batches]),
-            np.stack([b.contexts for b in batches]),
-            np.stack([b.mask for b in batches]),
-            base_key,
-            alphas,
+            group.centers, group.contexts, group.mask, base_key, alphas,
             step0,
         )
 
@@ -1297,19 +1446,33 @@ class Word2VecModel:
 
     def save(self, path: str) -> None:
         """Matrix shards + words list + params metadata (mllib:493-498:
-        ``matrix.save`` + the words text file; ml:504-507 params metadata)."""
+        ``matrix.save`` + the words text file; ml:504-507 params metadata).
+
+        Crash-safe: every file goes through write-temp-then-rename (the
+        matrix via the engine's snapshot commit, words/params here), so
+        re-saving over an existing model directory can never leave a
+        truncated words file or params blob behind."""
+        from glint_word2vec_tpu.utils import (
+            atomic_write_json,
+            atomic_write_text,
+        )
+
         os.makedirs(path, exist_ok=True)
         self.engine.save(os.path.join(path, "matrix"))
-        with open(os.path.join(path, "words.txt"), "w", encoding="utf-8") as f:
-            for w in self.vocab.words:
-                if "\n" in w or "\r" in w:
-                    raise ValueError(
-                        f"vocab word {w!r} contains a newline and cannot be "
-                        "saved to the line-oriented words file"
-                    )
-                f.write(w + "\n")
-        with open(os.path.join(path, "params.json"), "w") as f:
-            f.write(self.params.to_json())
+        for w in self.vocab.words:
+            if "\n" in w or "\r" in w:
+                raise ValueError(
+                    f"vocab word {w!r} contains a newline and cannot be "
+                    "saved to the line-oriented words file"
+                )
+        atomic_write_text(
+            os.path.join(path, "words.txt"),
+            "".join(w + "\n" for w in self.vocab.words),
+        )
+        atomic_write_json(
+            os.path.join(path, "params.json"),
+            json.loads(self.params.to_json()),
+        )
 
     #: Params class used by :meth:`load`; model families override.
     _PARAMS_CLS = Word2VecParams
@@ -1412,11 +1575,20 @@ class LocalWord2VecModel:
         return {w: self.vectors[i] for i, w in enumerate(self.words)}
 
     def save(self, path: str) -> None:
+        """Crash-safe: both files land via write-temp-then-rename
+        (utils.atomic_write_npy), so overwriting a previous save can
+        never leave a truncated ``vectors.npy`` behind."""
+        from glint_word2vec_tpu.utils import (
+            atomic_write_npy,
+            atomic_write_text,
+        )
+
         os.makedirs(path, exist_ok=True)
-        np.save(os.path.join(path, "vectors.npy"), self.vectors)
-        with open(os.path.join(path, "words.txt"), "w", encoding="utf-8") as f:
-            for w in self.words:
-                f.write(w + "\n")
+        atomic_write_npy(os.path.join(path, "vectors.npy"), self.vectors)
+        atomic_write_text(
+            os.path.join(path, "words.txt"),
+            "".join(w + "\n" for w in self.words),
+        )
 
     @classmethod
     def load(cls, path: str) -> "LocalWord2VecModel":

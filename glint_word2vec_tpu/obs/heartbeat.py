@@ -164,10 +164,33 @@ class TrainingStatus:
                 "host_time": round(ht, 2),
                 "step_time": round(st, 2),
                 "host_frac": round(ht / max(ht + st, 1e-9), 3),
+                # Rolling host-side dispatch-starvation proxy (ISSUE 5):
+                # blocking checkpoint saves + batch-producer waits +
+                # epoch-boundary compaction syncs. Async checkpointing,
+                # deferred readbacks, and prefetch overlap each shrink it.
+                "device_stall_seconds": round(
+                    getattr(m, "stall_time", 0.0), 3
+                ),
             })
         if eng is not None:
             snap["table_version"] = int(getattr(eng, "table_version", 0))
             snap["query_compiles"] = int(getattr(eng, "query_compiles", 0))
+            ck_stats = getattr(eng, "checkpoint_stats", None)
+            if ck_stats is not None:
+                try:
+                    ck = ck_stats()
+                except Exception:  # telemetry must never kill the server
+                    ck = {}
+                snap["pending_async_saves"] = ck.get(
+                    "pending_async_saves", 0
+                )
+                snap["async_save_waits"] = ck.get("async_save_waits", 0)
+                snap["checkpoint_write_seconds"] = _finite_or_none(
+                    ck.get("checkpoint_write_seconds")
+                )
+                snap["last_checkpoint_age_seconds"] = _finite_or_none(
+                    ck.get("last_checkpoint_age_seconds")
+                )
         if rec is not None:
             snap["events"] = rec.counts()
         if include_devices:

@@ -82,6 +82,17 @@ def training_to_prometheus(snap: dict) -> str:
          "Most recently synced per-step loss (NaN until first sync)."),
         ("glint_training_host_frac", "host_frac",
          "Fraction of accounted wall time spent in host batching."),
+        ("glint_training_device_stall_seconds", "device_stall_seconds",
+         "Host-side dispatch-starvation proxy: blocking checkpoint "
+         "saves + batch-producer waits + compaction syncs."),
+        ("glint_training_pending_async_saves", "pending_async_saves",
+         "Async checkpoint snapshots currently in flight (0 or 1)."),
+        ("glint_training_checkpoint_write_seconds",
+         "checkpoint_write_seconds",
+         "Wall seconds of the most recent checkpoint write job."),
+        ("glint_training_last_checkpoint_age_seconds",
+         "last_checkpoint_age_seconds",
+         "Seconds since the last committed checkpoint (NaN before any)."),
         ("glint_training_uptime_seconds", "uptime_seconds",
          "Seconds since the fit's observability run started."),
         ("glint_training_table_version", "table_version",
@@ -101,6 +112,9 @@ def training_to_prometheus(snap: dict) -> str:
          "Trained words (pre-subsampling accounting)."),
         ("glint_training_query_compiles_total", "query_compiles",
          "Query-op shapes jit-compiled by the engine."),
+        ("glint_training_async_save_waits_total", "async_save_waits",
+         "Checkpoint requests that blocked on a still-in-flight "
+         "snapshot (checkpoint back-pressure)."),
     ]
     for name, key, help_ in counters:
         p.head(name, "counter", help_)
@@ -190,6 +204,20 @@ def serving_to_prometheus(snap: dict) -> str:
            "Compiles past serving warmup (the zero-compile contract).")
     p.sample("glint_serving_post_warmup_compiles", None,
              compiles.get("post_warmup", 0))
+    ck = snap.get("checkpoint") or {}
+    p.head("glint_serving_pending_async_saves", "gauge",
+           "Async table snapshots in flight on the served engine.")
+    p.sample("glint_serving_pending_async_saves", None,
+             ck.get("pending_async_saves", 0))
+    p.head("glint_serving_checkpoint_write_seconds", "gauge",
+           "Wall seconds of the engine's most recent snapshot write.")
+    p.sample("glint_serving_checkpoint_write_seconds", None,
+             ck.get("checkpoint_write_seconds"))
+    p.head("glint_serving_last_checkpoint_age_seconds", "gauge",
+           "Seconds since the engine last committed a table snapshot "
+           "(NaN when it never has).")
+    p.sample("glint_serving_last_checkpoint_age_seconds", None,
+             ck.get("last_checkpoint_age_seconds"))
     return p.text()
 
 

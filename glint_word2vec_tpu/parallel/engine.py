@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -1043,6 +1044,17 @@ class EmbeddingEngine:
         # derived from table values without holding device buffers.
         self._norms_cache = None
         self.table_version = 0
+        # Non-blocking checkpoint machinery (ISSUE 5): the single
+        # background writer (lazily created by save_async) and the
+        # commit telemetry the heartbeat surfaces.
+        self._ckpt_writer = None
+        self._ckpt_last_commit: Optional[float] = None
+        self._ckpt_last_write_s: Optional[float] = None
+        self._ckpt_forced_sync = 0
+        # Pre-dispatched next-epoch subsample-compact pass (ISSUE 5
+        # prefetch overlap): (epoch_key host copy, ids_c, offsets_c,
+        # n_kept) awaiting adoption by compact_corpus.
+        self._compact_prefetch = None
 
     # ------------------------------------------------------------------
     # Training
@@ -1253,6 +1265,32 @@ class EmbeddingEngine:
                     a.delete()
                 except Exception:
                     pass
+        pre, self._compact_prefetch = self._compact_prefetch, None
+        if pre is not None and np.array_equal(
+            pre[0], np.asarray(epoch_key)
+        ):
+            # Adopt the pass prefetch_compact_corpus dispatched while the
+            # previous epoch's tail group was still executing: same jitted
+            # function, same key — bitwise-identical buffers, already (or
+            # still becoming) computed on device.
+            ids_c, offsets_c, n_kept = pre[1], pre[2], pre[3]
+        else:
+            if pre is not None:
+                # Prefetched for a different key (e.g. an out-of-order
+                # resume): discard, recompute fresh.
+                for a in pre[1:3]:
+                    try:
+                        a.delete()
+                    except Exception:
+                        pass
+            ids_c, offsets_c, n_kept = self._compact_dispatch(epoch_key)
+        self._corpus_compacted = (ids_c, offsets_c)
+        self._n_kept = int(n_kept)
+        return self._n_kept
+
+    def _compact_dispatch(self, epoch_key):
+        """Dispatch (without blocking) one subsample-compact pass over
+        the uploaded flat corpus; returns the lazy device triple."""
         if not hasattr(self, "_compact_fn"):
             from glint_word2vec_tpu.ops.device_batching import (
                 subsample_compact,
@@ -1260,12 +1298,34 @@ class EmbeddingEngine:
 
             self._compact_fn = jax.jit(subsample_compact)
         ids, offsets = self._corpus
-        ids_c, offsets_c, n_kept = self._compact_fn(
-            ids, offsets, self._keep_prob, epoch_key
-        )
-        self._corpus_compacted = (ids_c, offsets_c)
-        self._n_kept = int(n_kept)
-        return self._n_kept
+        return self._compact_fn(ids, offsets, self._keep_prob, epoch_key)
+
+    def prefetch_compact_corpus(self, epoch_key) -> None:
+        """Dispatch the NEXT epoch's subsample-compact pass into fresh
+        device buffers without adopting them — called by the fit loop
+        while the current epoch's tail group is still executing, so the
+        per-epoch compaction overlaps training instead of serializing
+        the epoch boundary (ISSUE 5 prefetch overlap). The buffers are
+        adopted by the next :meth:`compact_corpus` call with the same
+        ``epoch_key`` (bitwise identical to computing them there); the
+        currently-active compacted view is untouched until then. Costs
+        one extra transient compacted buffer of HBM until adoption."""
+        if getattr(self, "_corpus", None) is None:
+            raise ValueError("no corpus uploaded (call upload_corpus first)")
+        if getattr(self, "_keep_prob", None) is None:
+            raise ValueError(
+                "no keep probabilities installed (call set_keep_probs first)"
+            )
+        old, self._compact_prefetch = self._compact_prefetch, None
+        if old is not None:
+            for a in old[1:3]:
+                try:
+                    a.delete()
+                except Exception:
+                    pass
+        key_h = np.asarray(epoch_key)
+        ids_c, offsets_c, n_kept = self._compact_dispatch(epoch_key)
+        self._compact_prefetch = (key_h, ids_c, offsets_c, n_kept)
 
     def compacted_offsets(self) -> np.ndarray:
         """Host copy of the active epoch's compacted sentence offsets —
@@ -1625,7 +1685,7 @@ class EmbeddingEngine:
     def save(self, path: str, mode: str = "sharded") -> None:
         """Write both matrices + engine metadata (Glint ``matrix.save``,
         mllib:494 — each server flushing its shard to HDFS becomes each
-        mesh slice flushing its row block).
+        mesh slice flushing its row block). Blocks until committed.
 
         ``mode="sharded"`` (default) writes one ``.npy`` per owned model-axis
         row block — no host ever materializes a full table (the save-side
@@ -1633,7 +1693,338 @@ class EmbeddingEngine:
         and under multi-host each process writes only its addressable
         shards. ``mode="single"`` writes one full-table file (handy for
         small models / interop). Both re-load onto any mesh shape.
+
+        Crash safety (single-process): a fresh ``path`` is written as a
+        temp directory and committed with one atomic rename — a kill
+        mid-write leaves only an unreferenced ``*.tmp-*`` directory; an
+        existing ``path`` is updated per-file via temp + ``os.replace``
+        with the ``engine.json`` manifest written last. Multi-host keeps
+        the legacy in-place protocol (every process writes disjoint
+        shard files; the fit loop's barrier + ``train_state.json`` flip
+        is the commit point there).
         """
+        if jax.process_count() > 1:
+            return self._save_multihost(path, mode)
+        # Blocking path: views of the live tables are safe to serialize
+        # directly — no donating dispatch can run until this returns —
+        # so skip the deep copy (and its transient 2x host memory).
+        files, meta = self._snapshot_host(
+            self.syn0, self.syn1, mode, deep_copy=False
+        )
+        self._write_snapshot(path, files, meta)
+
+    # -- non-blocking checkpointing (ISSUE 5) ---------------------------
+
+    def async_saves_enabled(self) -> bool:
+        """Whether :meth:`save_async` will actually run non-blocking:
+        single-process only (multi-host saves need the cross-process
+        barrier before the state flip) and not escape-hatched by
+        ``GLINT_SYNC_CKPT=1`` (README "Checkpointing")."""
+        return (
+            jax.process_count() == 1
+            and os.environ.get("GLINT_SYNC_CKPT", "0") != "1"
+        )
+
+    def save_async(self, path: str, mode: str = "sharded",
+                   on_commit=None) -> bool:
+        """Non-blocking :meth:`save`: snapshot the (donation-cycled)
+        tables to host memory — the device->host copy is the ONLY work
+        on the calling thread — then hand serialization + atomic commit
+        to the single background writer thread (utils/async_ckpt.py).
+        At most one snapshot is in flight — a second request blocks for
+        the first (counted in ``async_save_waits``). ``on_commit`` runs
+        on the writer thread strictly AFTER the snapshot directory is
+        committed (the fit loops flip ``train_state.json`` there), so a
+        crash mid-write can never dangle the manifest. Falls back to a
+        blocking save (returning False) under multi-host or
+        ``GLINT_SYNC_CKPT=1``."""
+        if not self.async_saves_enabled():
+            self.save(path, mode)
+            self._ckpt_forced_sync += 1
+            if on_commit is not None:
+                on_commit()
+            return False
+        if self._ckpt_writer is None:
+            from glint_word2vec_tpu.utils.async_ckpt import (
+                AsyncSnapshotWriter,
+            )
+
+            self._ckpt_writer = AsyncSnapshotWriter()
+        writer = self._ckpt_writer
+        # Block for any in-flight snapshot BEFORE materializing this one
+        # (counted as back-pressure): transient host memory stays
+        # bounded to one extra table pair.
+        writer.wait_for_slot()
+        files, meta = self._snapshot_host(self.syn0, self.syn1, mode)
+
+        def job():
+            with obs_events.span("ckpt_write", ckpt=path):
+                self._write_snapshot(path, files, meta)
+                if on_commit is not None:
+                    on_commit()
+
+        writer.submit(job)
+        return True
+
+    def wait_pending_saves(self, *, reraise: bool = True) -> None:
+        """Barrier: block until no async save is in flight. The fit
+        loops run it at fit exit (and implicitly before every state
+        flip, since commits are ordered through the single writer);
+        ``reraise=False`` is the exception-path variant that must not
+        mask the original failure."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait(reraise=reraise)
+
+    def checkpoint_stats(self) -> dict:
+        """Checkpoint telemetry for the heartbeat / serving snapshots:
+        ``pending_async_saves`` (0/1), ``async_save_waits`` (blocked
+        second requests — checkpoint back-pressure),
+        ``checkpoint_write_seconds`` (last write job wall time),
+        ``last_checkpoint_age_seconds`` (since the last commit, sync or
+        async; None before any), ``forced_sync_saves``."""
+        w = self._ckpt_writer
+        last_write = self._ckpt_last_write_s
+        last_commit = self._ckpt_last_commit
+        ws = w.stats() if w is not None else {}
+        if ws.get("last_write_seconds") is not None:
+            last_write = ws["last_write_seconds"]
+        if ws.get("last_commit_time") is not None:
+            last_commit = max(last_commit or 0.0, ws["last_commit_time"])
+        return {
+            "pending_async_saves": int(ws.get("pending", 0)),
+            "async_save_waits": int(ws.get("blocked_waits", 0)),
+            "checkpoint_write_seconds": (
+                round(last_write, 4) if last_write is not None else None
+            ),
+            "last_checkpoint_age_seconds": (
+                round(time.time() - last_commit, 2)
+                if last_commit else None
+            ),
+            "forced_sync_saves": self._ckpt_forced_sync,
+        }
+
+    def _snapshot_host(self, syn0, syn1, mode: str, *,
+                       deep_copy: bool = True):
+        """Blocking device->host snapshot of the given table pair:
+        returns ``(files, meta)`` where ``files`` is a list of
+        ``(filename, ndarray)`` blocks and ``meta`` the ``engine.json``
+        manifest dict. With ``deep_copy`` (the async path) every block
+        is a DEEP host copy — the live tables may be donated to the next
+        dispatch the moment the caller resumes, and a zero-copy
+        CPU-backend view of a donated buffer would read garbage; the
+        copies run on a small thread pool (numpy releases the GIL for
+        the memcpy) and their latency is the async checkpoint pause.
+        ``deep_copy=False`` (the blocking save, which serializes before
+        returning) keeps the views and skips the extra table-pair of
+        transient host memory."""
+        files = []
+        if mode == "sharded":
+            shard_files = self._shard_manifest()
+            for name, table in (("syn0", syn0), ("syn1", syn1)):
+                for fname, block in self._iter_owned_blocks(name, table):
+                    files.append([fname, block])
+        elif mode == "single":
+            for name, table in (("syn0", syn0), ("syn1", syn1)):
+                files.append([
+                    f"{name}.npy",
+                    np.asarray(table)[: self.num_rows, : self.dim],
+                ])
+        else:
+            raise ValueError("mode must be 'sharded' or 'single'")
+        if deep_copy:
+            # Deep-copy every block in parallel: np.asarray above may be
+            # a zero-copy view of the live device buffer on the CPU
+            # backend.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(max(len(files), 1), 8),
+                thread_name_prefix="glint-snap",
+            ) as pool:
+                for entry, copied in zip(
+                    files,
+                    pool.map(
+                        lambda e: np.array(e[1], dtype=np.float32), files
+                    ),
+                ):
+                    entry[1] = copied
+        else:
+            # Cast-only (no copy for f32 tables): the blocking caller
+            # serializes before any donating dispatch can run.
+            for entry in files:
+                entry[1] = np.asarray(entry[1], dtype=np.float32)
+        files = [tuple(e) for e in files]
+        files.append(
+            ("counts.npy", np.asarray(self._counts_unpadded(), np.int64))
+        )
+        meta = self._save_meta(mode)
+        if mode == "sharded":
+            meta["shards"] = shard_files
+        return files, meta
+
+    def _shard_geometry(self):
+        """(axis, per_shard, real_extent) of the sharded-save layout —
+        the one place the manifest and the block producers agree on."""
+        axis = "rows" if self.layout == "rows" else "cols"
+        per_shard = (
+            self.rows_per_shard if axis == "rows" else self.cols_per_shard
+        )
+        real_extent = self.num_rows if axis == "rows" else self.dim
+        return axis, per_shard, real_extent
+
+    def _shard_manifest(self) -> dict:
+        """Deterministic (mesh-geometry-only) shard-file manifest shared
+        by the single-process snapshot and the multi-host in-place save
+        — identical producers, so checkpoints from either path re-load
+        interchangeably."""
+        axis, per_shard, real_extent = self._shard_geometry()
+        shard_files = {"syn0": [], "syn1": []}
+        for name in ("syn0", "syn1"):
+            for k in range(self.num_model):
+                start = k * per_shard
+                stop = min(start + per_shard, real_extent)
+                if start >= stop:
+                    continue  # pure-padding block
+                shard_files[name].append({
+                    "file": f"{name}.{axis[0]}{start:012d}.npy",
+                    "start": start, "stop": stop, "axis": axis,
+                })
+        return shard_files
+
+    def _iter_owned_blocks(self, name: str, table):
+        """Yield ``(fname, block)`` for every shard block this process
+        owns (replica 0 of each block, once), sliced to the real
+        (unpadded) extent. Blocks may be zero-copy views of the device
+        buffers — callers that outlive the next donating dispatch must
+        deep-copy."""
+        axis, per_shard, real_extent = self._shard_geometry()
+        ix = 0 if axis == "rows" else 1
+        for shard in table.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            start = shard.index[ix].start or 0
+            if start >= real_extent:
+                continue
+            stop = min(start + per_shard, real_extent)
+            data = np.asarray(shard.data)
+            if axis == "rows":
+                block = data[: stop - start]
+            else:
+                block = data[: self.num_rows, : stop - start]
+            yield f"{name}.{axis[0]}{start:012d}.npy", block
+
+    def _save_meta(self, mode: str) -> dict:
+        return {
+            "format": mode,
+            "layout": self.layout,
+            "vocab_size": self.vocab_size,
+            "dim": self.dim,
+            "num_negatives": self.num_negatives,
+            "unigram_power": self.unigram_power,
+            "unigram_table_size": self.unigram_table_size,
+            "extra_rows": self.num_rows - self.vocab_size,
+            "dtype": (
+                "bfloat16" if self._dtype == jnp.bfloat16 else "float32"
+            ),
+            "shared_negatives": self.shared_negatives,
+        }
+
+    def _write_snapshot(self, path: str, files, meta: dict) -> None:
+        """Serialize a host snapshot to disk with a crash-safe commit.
+
+        Fresh ``path`` (every checkpoint dir): everything lands in a
+        sibling temp directory first — each file fsync'd, so the rename
+        can never commit a checkpoint whose bytes are still only in the
+        page cache (a power loss after the rename must not roll the
+        DATA back) — then ONE atomic rename makes the whole snapshot
+        appear, followed by a parent-directory fsync to make the rename
+        itself durable. A kill at any earlier point leaves only an
+        unreferenced ``*.tmp-*`` directory (pruned by the next state
+        flip). ``GLINT_CKPT_NO_FSYNC=1`` skips the fsyncs (fast local
+        scratch / tests). Existing ``path`` (re-saving a model dir in
+        place): each file goes through temp + ``os.replace`` and the
+        ``engine.json`` manifest is written last, so no file is ever
+        truncated."""
+        t0 = time.time()
+        fsync = os.environ.get("GLINT_CKPT_NO_FSYNC", "0") != "1"
+        if not os.path.exists(path):
+            tmp = f"{path}.tmp-{os.getpid()}"
+            if os.path.exists(tmp):
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            for fname, arr in files:
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    np.save(f, arr)
+                    if fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+            with open(os.path.join(tmp, "engine.json"), "w") as f:
+                json.dump(meta, f)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            if fsync:
+                # The dirents too, not just the file data: fsync(file)
+                # alone need not persist the entry in its directory.
+                self._fsync_dir(tmp)
+            self._commit_snapshot_dir(tmp, path)
+            if fsync:
+                self._fsync_dir(os.path.dirname(os.path.abspath(path)))
+        else:
+            # In-place update (model re-save over an existing dir, or
+            # re-writing an orphaned checkpoint dir after a crash):
+            # per-file temp + replace — every file is always either the
+            # old or the new complete version — with the same fsync
+            # durability as the fresh-dir path, and the engine.json
+            # manifest last.
+            def _put(fname, writer_fn):
+                tmp_f = os.path.join(path, f"{fname}.tmp.{os.getpid()}")
+                with open(tmp_f, "wb") as f:
+                    writer_fn(f)
+                    if fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                os.replace(tmp_f, os.path.join(path, fname))
+
+            for fname, arr in files:
+                _put(fname, lambda f, a=arr: np.save(f, a))
+            _put(
+                "engine.json",
+                lambda f: f.write(json.dumps(meta).encode()),
+            )
+            if fsync:
+                self._fsync_dir(os.path.abspath(path))
+        self._ckpt_last_write_s = time.time() - t0
+        self._ckpt_last_commit = time.time()
+
+    @staticmethod
+    def _fsync_dir(dirpath: str) -> None:
+        """Make renames inside ``dirpath`` durable; best-effort (some
+        filesystems refuse directory fsync)."""
+        try:
+            dfd = os.open(dirpath, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _commit_snapshot_dir(tmp: str, path: str) -> None:
+        """THE commit point of a fresh-directory snapshot: one atomic
+        rename. Kept as its own (monkeypatchable) seam so the
+        crash-mid-checkpoint test can kill the writer between temp-write
+        and rename and assert the previous checkpoint survives."""
+        os.rename(tmp, path)
+
+    def _save_multihost(self, path: str, mode: str = "sharded") -> None:
+        """Legacy in-place save for multi-host runs: every process
+        writes its own addressable shard files into ``path``; process 0
+        writes counts + manifest. Commit/crash-safety is the caller's
+        barrier + ``train_state.json`` flip."""
         os.makedirs(path, exist_ok=True)
         shard_files = {"syn0": [], "syn1": []}
         if mode == "sharded":
@@ -1643,38 +2034,12 @@ class EmbeddingEngine:
             # are row ranges under the rows layout and column ranges under
             # the dims layout ("axis" in each manifest entry; absent =
             # rows, for round-2 checkpoints).
-            axis = "rows" if self.layout == "rows" else "cols"
-            per_shard = (
-                self.rows_per_shard if axis == "rows" else self.cols_per_shard
-            )
-            real_extent = self.num_rows if axis == "rows" else self.dim
+            shard_files = self._shard_manifest()
             for name, table in (("syn0", self.syn0), ("syn1", self.syn1)):
-                for k in range(self.num_model):
-                    start = k * per_shard
-                    stop = min(start + per_shard, real_extent)
-                    if start >= stop:
-                        continue  # pure-padding block
-                    fname = f"{name}.{axis[0]}{start:012d}.npy"
-                    shard_files[name].append(
-                        {"file": fname, "start": start, "stop": stop,
-                         "axis": axis}
-                    )
-                ix = 0 if axis == "rows" else 1
-                for shard in table.addressable_shards:
-                    if shard.replica_id != 0:
-                        continue  # replica 0 of each block writes, once
-                    start = shard.index[ix].start or 0
-                    if start >= real_extent:
-                        continue
-                    stop = min(start + per_shard, real_extent)
-                    data = np.asarray(shard.data, dtype=np.float32)
-                    if axis == "rows":
-                        block = data[: stop - start]
-                    else:
-                        block = data[: self.num_rows, : stop - start]
+                for fname, block in self._iter_owned_blocks(name, table):
                     np.save(
-                        os.path.join(path, f"{name}.{axis[0]}{start:012d}.npy"),
-                        block,
+                        os.path.join(path, fname),
+                        np.asarray(block, dtype=np.float32),
                     )
         else:
             if mode != "single":
@@ -1691,18 +2056,7 @@ class EmbeddingEngine:
         if jax.process_index() == 0:
             counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
             np.save(os.path.join(path, "counts.npy"), counts)
-        meta = {
-            "format": mode,
-            "layout": self.layout,
-            "vocab_size": self.vocab_size,
-            "dim": self.dim,
-            "num_negatives": self.num_negatives,
-            "unigram_power": self.unigram_power,
-            "unigram_table_size": self.unigram_table_size,
-            "extra_rows": self.num_rows - self.vocab_size,
-            "dtype": "bfloat16" if self._dtype == jnp.bfloat16 else "float32",
-            "shared_negatives": self.shared_negatives,
-        }
+        meta = self._save_meta(mode)
         if mode == "sharded":
             meta["shards"] = shard_files
         # Multi-host: every process wrote disjoint shard files; exactly one
@@ -1839,14 +2193,20 @@ class EmbeddingEngine:
         self._tick_tables("set_tables")
 
     def destroy(self) -> None:
-        """Free device memory (Glint ``matrix.destroy``, mllib:665)."""
+        """Free device memory (Glint ``matrix.destroy``, mllib:665).
+        Drains any in-flight async save first (its snapshot copies are
+        separate buffers, but a half-written checkpoint helps nobody)."""
+        self.wait_pending_saves(reraise=False)
         corpus = getattr(self, "_corpus", None) or ()
         compacted = getattr(self, "_corpus_compacted", None) or ()
         keep_prob = getattr(self, "_keep_prob", None)
         extras = (keep_prob,) if keep_prob is not None else ()
+        pre = getattr(self, "_compact_prefetch", None)
+        prefetched = pre[1:3] if pre is not None else ()
+        self._compact_prefetch = None
         for a in (
             self.syn0, self.syn1, self._prob, self._alias,
-            *corpus, *compacted, *extras,
+            *corpus, *compacted, *extras, *prefetched,
         ):
             try:
                 a.delete()

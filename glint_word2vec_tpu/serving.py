@@ -425,7 +425,8 @@ class ModelServer:
                         )
                     elif url.path == "/metrics":
                         snap = server.metrics.snapshot(
-                            server._query_compiles()
+                            server._query_compiles(),
+                            checkpoint=server._checkpoint_stats(),
                         )
                         fmt = parse_qs(url.query).get("format", ["json"])[0]
                         if fmt == "prometheus":
@@ -495,6 +496,20 @@ class ModelServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- warmup / compile accounting ----------------------------------
+
+    def _checkpoint_stats(self) -> dict:
+        """Checkpoint telemetry of the served engine (ISSUE 5): a model
+        served straight out of a training process reports its snapshot
+        pipeline; a freshly-loaded model reports Nones. Never raises —
+        /metrics must stay up regardless."""
+        eng = getattr(self.model, "engine", None)
+        stats = getattr(eng, "checkpoint_stats", None)
+        if stats is None:
+            return {}
+        try:
+            return stats()
+        except Exception:
+            return {}
 
     def _query_compiles(self) -> int:
         """Total query-op shapes compiled across the model's engines

@@ -15,6 +15,29 @@ def atomic_write_json(path: str, obj) -> None:
     _os.replace(tmp, path)
 
 
+def atomic_write_text(path: str, text: str, encoding: str = "utf-8") -> None:
+    """Write a text file via temp + ``os.replace`` — same crash contract
+    as :func:`atomic_write_json` for line-oriented files (words lists)."""
+    tmp = f"{path}.tmp.{_os.getpid()}"
+    with open(tmp, "w", encoding=encoding) as f:
+        f.write(text)
+    _os.replace(tmp, path)
+
+
+def atomic_write_npy(path: str, arr) -> None:
+    """``np.save`` via temp file + ``os.replace`` — the array twin of
+    :func:`atomic_write_json`: readers either see the previous complete
+    array or the new complete one, never a truncated ``.npy``. Writes
+    through a file object so numpy cannot append a second ``.npy``
+    suffix to the temp name."""
+    import numpy as _np
+
+    tmp = f"{path}.tmp.{_os.getpid()}"
+    with open(tmp, "wb") as f:
+        _np.save(f, arr)
+    _os.replace(tmp, path)
+
+
 def next_pow2(n: int) -> int:
     """Smallest power of two >= n (1 for n <= 1). The shape-bucket
     quantizer for the serving hot path: padding device dispatches to

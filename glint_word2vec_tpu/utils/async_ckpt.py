@@ -1,0 +1,154 @@
+"""Background checkpoint writer: the host half of non-blocking saves.
+
+The fit loops' checkpoint sites used to serialize the device behind host
+work: ``engine.save`` blocked the dispatching thread for the full
+device->host copy + ``np.save`` + fsync + rename of both tables, so
+every checkpoint was a bubble in the device pipeline (ISSUE 5). The
+engine now snapshots the tables device->host on the calling thread —
+parallel per-block deep copies, the ONLY blocking work it still does —
+and hands serialization + durability fsyncs + atomic commit to the
+single writer thread this module owns. The residual call-site pause is
+the snapshot copy alone (``bench.py stall_overlap`` measures it at
+<20% of the blocking save's pause).
+
+Deliberately a depth-1 pipeline: at most ONE snapshot is ever in flight.
+A second request blocks until the first commits (counted in
+``blocked_waits`` — visible on the heartbeat as back-pressure), which
+bounds the transient snapshot memory to one table pair and keeps commits
+strictly ordered, so ``train_state.json`` can never flip to a checkpoint
+older than one already committed.
+
+Failure contract: a failed write never crashes the training thread
+mid-dispatch — the error is held and re-raised at the next ``submit`` or
+at the ``wait()`` barrier the fit loops run before declaring the run
+done. Because the commit callback (the ``train_state.json`` flip) runs
+only after a successful write, a failed or killed write leaves the
+previous committed checkpoint authoritative.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncSnapshotWriter:
+    """Single daemon writer thread with a one-deep job hand-off.
+
+    Written for a single submitting thread (the fit loop); concurrent
+    submitters are not supported (they would race the in-flight guard).
+    The writer thread is started lazily on first submit and is a daemon,
+    so an abandoned engine never pins process exit.
+    """
+
+    def __init__(self, name: str = "glint-ckpt-writer"):
+        self._jobs: queue.Queue = queue.Queue(maxsize=1)
+        self._mu = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        #: Snapshots queued but not yet committed (0 or 1).
+        self.pending = 0
+        #: Times a submit found the previous snapshot still in flight and
+        #: had to block for it — checkpoint back-pressure, surfaced on
+        #: the heartbeat (``async_save_waits``).
+        self.blocked_waits = 0
+        #: Successfully committed snapshots.
+        self.commits = 0
+        #: Wall seconds of the most recent write job (host-side copy +
+        #: serialization + commit), successful or not.
+        self.last_write_seconds: Optional[float] = None
+        #: time.time() of the most recent successful commit.
+        self.last_commit_time: Optional[float] = None
+
+    # -- writer thread --------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=self._name
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            t0 = time.time()
+            try:
+                job()
+                with self._mu:
+                    self.commits += 1
+                    self.last_commit_time = time.time()
+            except BaseException as e:  # held for the submitting thread
+                logger.error("async checkpoint write failed: %s", e)
+                with self._mu:
+                    self._error = e
+            finally:
+                with self._mu:
+                    self.pending -= 1
+                    self.last_write_seconds = time.time() - t0
+                self._idle.set()
+
+    # -- submitting-thread API ------------------------------------------
+
+    def wait_for_slot(self) -> None:
+        """Block until no snapshot is in flight (counted in
+        ``blocked_waits`` when it actually blocks) and surface any prior
+        write error. Callers invoke this BEFORE materializing a new
+        snapshot, so transient snapshot memory stays bounded to ONE
+        table pair — snapshotting first and blocking in submit would
+        briefly hold two."""
+        if not self._idle.is_set():
+            with self._mu:
+                self.blocked_waits += 1
+            self._idle.wait()
+        self.raise_pending_error()
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Queue one snapshot job. Blocks while a previous snapshot is
+        still in flight (the at-most-one guard; prefer
+        :meth:`wait_for_slot` before building the snapshot); re-raises
+        any error a previous job recorded — the failed save's state flip
+        never ran, so the caller learns before trusting the checkpoint
+        chain."""
+        self._ensure_thread()
+        self.wait_for_slot()
+        with self._mu:
+            self.pending += 1
+        self._idle.clear()
+        self._jobs.put(job)
+
+    def wait(self, *, reraise: bool = True) -> None:
+        """Barrier: return once no snapshot is in flight. ``reraise``
+        surfaces a held write error (the fit-exit barrier wants it; the
+        exception-path cleanup barrier must not mask the original
+        failure and passes False)."""
+        self._idle.wait()
+        if reraise:
+            self.raise_pending_error()
+
+    def raise_pending_error(self) -> None:
+        with self._mu:
+            e, self._error = self._error, None
+        if e is not None:
+            raise RuntimeError(
+                "asynchronous checkpoint write failed; the previous "
+                "committed checkpoint is still authoritative"
+            ) from e
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "pending": self.pending,
+                "blocked_waits": self.blocked_waits,
+                "commits": self.commits,
+                "last_write_seconds": self.last_write_seconds,
+                "last_commit_time": self.last_commit_time,
+            }

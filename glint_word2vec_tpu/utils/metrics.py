@@ -34,6 +34,13 @@ class TrainingMetrics:
     words_done: int = 0
     host_time: float = 0.0  # seconds spent producing batches
     step_time: float = 0.0  # seconds spent in train-step dispatch
+    #: Host-side seconds during which the dispatch pipeline was starved:
+    #: blocking checkpoint saves, waits for the batch producer, and
+    #: epoch-boundary compaction syncs. An upper-bound PROXY for device
+    #: idle time (the host may block on work the device is still busy
+    #: with), but its direction is exact: async checkpointing, deferred
+    #: readbacks, and prefetch overlap each shrink it (ISSUE 5).
+    stall_time: float = 0.0
     last_loss: Optional[float] = None
     #: Most recent per-step loss as an UNSYNCED device array; float()ed
     #: only at log points and in summary().
@@ -98,6 +105,19 @@ class TrainingMetrics:
             else:
                 self.step_time += dt
 
+    def record_stall(self, seconds: float) -> None:
+        self.stall_time += seconds
+
+    @contextlib.contextmanager
+    def stall_timing(self):
+        """Charge the wrapped block to ``stall_time`` (composable with
+        :meth:`timing`; the buckets are independent)."""
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.record_stall(time.time() - t0)
+
     def summary(self) -> dict:
         wall = max(time.time() - self._t_start, 1e-9)
         if self._last_loss_lazy is not None:
@@ -124,6 +144,7 @@ class TrainingMetrics:
             "words_per_sec": round((self.words_done - self.base_words) / wall, 1),
             "host_time": round(self.host_time, 2),
             "step_time": round(self.step_time, 2),
+            "device_stall_seconds": round(self.stall_time, 3),
             "final_loss": self.last_loss,
         }
 
@@ -231,7 +252,12 @@ class ServingMetrics:
             else:
                 self.cache_misses += 1
 
-    def snapshot(self, total_compiles: int = 0) -> dict:
+    def snapshot(self, total_compiles: int = 0,
+                 checkpoint: Optional[dict] = None) -> dict:
+        """``checkpoint`` is the engine's ``checkpoint_stats()`` dict
+        (pending_async_saves / last_checkpoint_age_seconds /
+        checkpoint_write_seconds); serving a freshly-loaded model reports
+        Nones — the keys exist either way so dashboards never branch."""
         with self._mu:
             endpoints = {}
             for path, h in sorted(self._hist.items()):
@@ -258,6 +284,17 @@ class ServingMetrics:
                     "warmup": int(self.warmup_compiles),
                     "post_warmup": int(total_compiles)
                     - int(self.warmup_compiles),
+                },
+                "checkpoint": {
+                    "pending_async_saves": (checkpoint or {}).get(
+                        "pending_async_saves", 0
+                    ),
+                    "last_checkpoint_age_seconds": (checkpoint or {}).get(
+                        "last_checkpoint_age_seconds"
+                    ),
+                    "checkpoint_write_seconds": (checkpoint or {}).get(
+                        "checkpoint_write_seconds"
+                    ),
                 },
             }
 
