@@ -110,6 +110,18 @@ def _add_train(sub):
                      help="steps between canary loss syncs; each check "
                           "blocks the async dispatch pipeline for one "
                           "device sync (default 32)")
+    dist_g = p.add_argument_group(
+        "distributed",
+        "multi-process bring-up (the supervise subcommand fills these "
+        "in per worker; set them by hand for custom launchers)",
+    )
+    dist_g.add_argument("--coordinator", default=None,
+                        help="host:port of the jax.distributed "
+                             "coordinator (process 0 binds it)")
+    dist_g.add_argument("--num-processes", type=int, default=None,
+                        help="total worker processes in the gang")
+    dist_g.add_argument("--process-id", type=int, default=None,
+                        help="this worker's rank in [0, num-processes)")
     p.add_argument("--fasttext", action="store_true",
                    help="train the subword (fastText-style) family")
     p.add_argument("--min-n", type=int, default=3,
@@ -160,6 +172,66 @@ def _add_query(sub):
     p.add_argument("--cache-size", type=int, default=65536,
                    help="synonym result-cache entries (0 disables); "
                         "invalidated wholesale on any table mutation")
+    over = p.add_argument_group(
+        "overload protection",
+        "bounded admission + per-request deadlines + degraded "
+        "cache-only mode, so a traffic spike sheds load instead of "
+        "queueing without bound (counters on /metrics)",
+    )
+    over.add_argument("--max-inflight", type=int, default=256,
+                      help="admission high-water mark: device-touching "
+                           "requests beyond this many in flight are "
+                           "shed with 429 + Retry-After (0 disables; "
+                           "default 256)")
+    over.add_argument("--request-deadline", type=float, default=30.0,
+                      help="per-request deadline seconds: a request "
+                           "that cannot reach the device in time is "
+                           "answered 504 instead of occupying a "
+                           "dispatch slot (0 disables; default 30)")
+    over.add_argument("--degraded-after", type=float, default=5.0,
+                      help="device-lock hold seconds after which the "
+                           "server enters degraded cache-only mode "
+                           "(serve cache hits, shed misses with 429) "
+                           "until the lock frees (0 disables; "
+                           "default 5)")
+
+    p = sub.add_parser(
+        "supervise",
+        help="run a train command under the elastic supervisor: gang "
+             "launch, crash/hang detection, teardown, resume from the "
+             "last committed checkpoint with capped backoff",
+    )
+    p.add_argument("--workers", type=int, default=1,
+                   help="worker process count (1 = supervised "
+                        "single-process fit; >1 launches a "
+                        "jax.distributed gang on a local coordinator)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="gang restart budget before giving up")
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="first restart delay seconds (doubles per "
+                        "restart, capped at --backoff-cap)")
+    p.add_argument("--backoff-cap", type=float, default=30.0)
+    p.add_argument("--heartbeat-stale", type=float, default=120.0,
+                   help="status-file heartbeat age that counts as a "
+                        "hang (0 disables hang detection)")
+    p.add_argument("--startup-grace", type=float, default=600.0,
+                   help="seconds a fresh worker may run without a "
+                        "first heartbeat (cold jax compiles are slow)")
+    p.add_argument("--supervise-dir", default=None,
+                   help="status files + worker logs directory "
+                        "(default: <checkpoint-dir>/supervisor)")
+    p.add_argument("--report-out", default=None,
+                   help="write the supervisor report JSON here too "
+                        "(it always prints to stdout)")
+    p.add_argument(
+        "train_args", nargs=argparse.REMAINDER,
+        help="the train command to supervise: everything after the "
+             "supervise flags, e.g. `supervise --workers 2 train "
+             "--corpus c.txt --output m/ --checkpoint-dir ck/`. "
+             "--checkpoint-dir is REQUIRED (recovery resumes from it); "
+             "the supervisor appends per-worker --status-file and "
+             "distributed flags itself",
+    )
 
     p = sub.add_parser(
         "eval", help="analogy accuracy on a standard question file"
@@ -195,7 +267,81 @@ def main(argv=None) -> int:
         return 1
 
 
+def _argv_value(argv, flag):
+    """Last value of ``--flag v`` / ``--flag=v`` in a raw argv list."""
+    val = None
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            val = argv[i + 1]
+        elif a.startswith(flag + "="):
+            val = a.split("=", 1)[1]
+    return val
+
+
+def _run_supervise(args) -> int:
+    """The supervise subcommand: a thin CLI shell over
+    ``parallel.supervisor.Supervisor``. Runs in a jax-free process —
+    the workers own the devices; the supervisor only watches pids and
+    status files."""
+    import os
+
+    train_args = list(args.train_args)
+    if train_args and train_args[0] == "--":
+        train_args = train_args[1:]
+    if not train_args or train_args[0] != "train":
+        print(
+            "error: supervise expects the train command to run, e.g. "
+            "`supervise --workers 2 train --corpus c.txt --output m/ "
+            "--checkpoint-dir ck/`",
+            file=sys.stderr,
+        )
+        return 1
+    rest = train_args[1:]
+    checkpoint_dir = _argv_value(rest, "--checkpoint-dir")
+    if checkpoint_dir is None:
+        print(
+            "error: supervise requires --checkpoint-dir in the train "
+            "arguments (recovery relaunches resume from it)",
+            file=sys.stderr,
+        )
+        return 1
+    sup_dir = args.supervise_dir or os.path.join(
+        checkpoint_dir, "supervisor"
+    )
+
+    from glint_word2vec_tpu.parallel.supervisor import (
+        Supervisor,
+        cli_train_build_argv,
+    )
+
+    report = Supervisor(
+        cli_train_build_argv(rest),
+        args.workers,
+        status_dir=sup_dir,
+        checkpoint_dir=checkpoint_dir,
+        heartbeat_stale_seconds=(
+            args.heartbeat_stale if args.heartbeat_stale > 0 else None
+        ),
+        startup_grace_seconds=args.startup_grace,
+        max_restarts=args.max_restarts,
+        backoff_base_seconds=args.backoff_base,
+        backoff_cap_seconds=args.backoff_cap,
+    ).run()
+    out = report.to_dict()
+    print(json.dumps(out))
+    if args.report_out:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.report_out, out)
+    return 0 if report.completed else 3
+
+
 def _run(args) -> int:
+    if args.cmd == "supervise":
+        # Before force_platform/jax: the supervisor process never
+        # touches a device.
+        return _run_supervise(args)
+
     from glint_word2vec_tpu.utils.platform import force_platform
 
     force_platform()  # a plain `JAX_PLATFORMS=cpu` must always work
@@ -203,6 +349,14 @@ def _run(args) -> int:
     from glint_word2vec_tpu import FastTextWord2Vec, Word2Vec, load_model
 
     if args.cmd == "train":
+        if args.coordinator or args.num_processes:
+            from glint_word2vec_tpu.parallel import distributed as dist
+
+            dist.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
         kw = dict(
             vector_size=args.vector_size,
             window=args.window,
@@ -269,6 +423,9 @@ def _run(args) -> int:
             args.model, host=args.host, port=args.port,
             max_batch=args.max_batch, warmup=not args.no_warmup,
             cache_size=args.cache_size,
+            max_inflight=args.max_inflight,
+            request_deadline=args.request_deadline,
+            degraded_after=args.degraded_after,
         )
         return 0
 

@@ -179,8 +179,14 @@ def group_batches(
     K = int(group_size)
     if K <= 0:
         raise ValueError("group_size must be > 0")
+    from glint_word2vec_tpu.utils import faults
+
     g = 0
     while True:
+        # Fault seam: fires on the producer thread, so an injected
+        # exception exercises the prefetch pipeline's error propagation
+        # and an injected hang exercises the consumer's stall accounting.
+        faults.fire("producer.batch")
         with obs_events.span("batch_prefetch", group=g):
             group: List[Batch] = []
             for batch in batches:

@@ -39,7 +39,7 @@ from glint_word2vec_tpu.corpus.batching import (
 )
 from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
 from glint_word2vec_tpu.obs import TrainingDiverged, start_run
-from glint_word2vec_tpu.utils import next_pow2
+from glint_word2vec_tpu.utils import faults, next_pow2
 from glint_word2vec_tpu.utils.metrics import TrainingMetrics
 from glint_word2vec_tpu.utils.params import Word2VecParams
 from glint_word2vec_tpu.utils.prefetch import prefetch
@@ -62,9 +62,30 @@ def _flip_checkpoint_state(
     tables (shared by the batcher and corpus-resident training loops).
     ``extra`` merges additional progress counters into the state (the
     packed corpus loop records its consumed-position counter and
-    grid-equivalent step base so mid-epoch resumes are exact)."""
+    grid-equivalent step base so mid-epoch resumes are exact).
+
+    Keep-last-2 retention: the previously committed record rides along
+    under ``"prev"`` and its snapshot directory survives the prune, so a
+    checkpoint that later fails integrity verification (bit rot, torn
+    write) has a committed fallback
+    (utils.integrity.resolve_train_state). Everything older is GC'd."""
     import shutil
 
+    prev = None
+    if os.path.exists(state_path):
+        try:
+            with open(state_path) as f:
+                prev = json.load(f)
+            prev.pop("prev", None)  # keep exactly two, not a chain
+        except (OSError, ValueError):
+            prev = None
+    if prev is not None and (
+        # A legacy record with no snapshot-dir name cannot serve as a
+        # fallback; re-committing the same name (repeated
+        # stop_after_epochs runs) must not point prev at ourselves.
+        "ckpt" not in prev or prev["ckpt"] == ck_name
+    ):
+        prev = None
     tmp = state_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(
@@ -74,15 +95,51 @@ def _flip_checkpoint_state(
                 "words_done": words_done,
                 "ckpt": ck_name,
                 **(extra or {}),
+                **({"prev": prev} if prev else {}),
             },
             f,
         )
     os.replace(tmp, state_path)
+    keep = {ck_name}
+    if prev:
+        keep.add(prev["ckpt"])
     for entry in os.listdir(checkpoint_dir):
-        if entry.startswith("ckpt-") and entry != ck_name:
+        if entry.startswith("ckpt-") and entry not in keep:
             shutil.rmtree(
                 os.path.join(checkpoint_dir, entry), ignore_errors=True
             )
+
+
+def _resolve_resume(checkpoint_dir: str) -> Optional[dict]:
+    """Resume-state resolution shared by both fit loops: the newest
+    committed checkpoint whose snapshot passes integrity verification
+    (manifest sha256 + sizes), falling back to the previous committed
+    record kept by the keep-last-2 retention. One clean log line per
+    rejected candidate; ``CheckpointCorruptError`` when nothing
+    verifies (never a silent from-scratch retrain)."""
+    from glint_word2vec_tpu.utils.integrity import resolve_train_state
+
+    resolved = resolve_train_state(checkpoint_dir)
+    if resolved is None:
+        return None
+    state, _ = resolved
+    return state
+
+
+def _ckpt_wait_timeout() -> Optional[float]:
+    """Fit-exit barrier timeout for in-flight async checkpoint writes:
+    a writer thread wedged on a dead filesystem must fail the run with
+    a named job, not pin fit exit forever. Seconds;
+    ``GLINT_CKPT_WAIT_TIMEOUT=0`` restores the unbounded wait."""
+    raw = os.environ.get("GLINT_CKPT_WAIT_TIMEOUT", "900")
+    try:
+        t = float(raw)
+    except ValueError:
+        logger.warning(
+            "GLINT_CKPT_WAIT_TIMEOUT=%r is not a number; using 900", raw
+        )
+        t = 900.0
+    return t if t > 0 else None
 
 
 def _checkpoint_tables(
@@ -510,9 +567,8 @@ class Word2Vec:
                 else None
             )
             resume_words = None
-            if state_path and os.path.exists(state_path):
-                with open(state_path) as f:
-                    state = json.load(f)
+            state = _resolve_resume(checkpoint_dir) if state_path else None
+            if state is not None:
                 with obs_run.span("checkpoint_restore", ckpt=state["ckpt"]):
                     engine.load_tables(
                         os.path.join(checkpoint_dir, state["ckpt"])
@@ -686,6 +742,7 @@ class Word2Vec:
                     next_start = pos  # host int now, device scalar later
                     dstep = step  # dispatch-time step0 (runs ahead)
                     while pos < n_pos:
+                        faults.fire("worker.step")
                         with metrics.timing("step"), obs_run.span(
                             "device_steps", step0=dstep, n=spc, packed=True
                         ):
@@ -759,6 +816,7 @@ class Word2Vec:
                     gstep += groups * spc
                 else:
                     for g in range(groups):
+                        faults.fire("worker.step")
                         start_pos = g * spc * B
                         with metrics.timing("host"), obs_run.span(
                             "host_batch", epoch=epoch, group=g
@@ -854,14 +912,20 @@ class Word2Vec:
                     break
             # Fit-exit barrier: the fit must not return (and the model
             # must not be saved over) while a snapshot write is in
-            # flight; a failed async write surfaces HERE, loudly.
-            engine.wait_pending_saves()
+            # flight; a failed async write surfaces HERE, loudly — and a
+            # HUNG writer raises after the bounded wait instead of
+            # pinning fit exit forever (GLINT_CKPT_WAIT_TIMEOUT).
+            engine.wait_pending_saves(timeout=_ckpt_wait_timeout())
         except TrainingDiverged:
-            engine.wait_pending_saves(reraise=False)
+            engine.wait_pending_saves(
+                reraise=False, timeout=_ckpt_wait_timeout()
+            )
             _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
             raise
         except BaseException:
-            engine.wait_pending_saves(reraise=False)
+            engine.wait_pending_saves(
+                reraise=False, timeout=_ckpt_wait_timeout()
+            )
             obs_run.close(failed=True)
             raise
         finally:
@@ -991,9 +1055,11 @@ class Word2Vec:
                 if checkpoint_dir
                 else None
             )
-            if state_path and os.path.exists(state_path):
-                with open(state_path) as f:
-                    state = json.load(f)
+            # Integrity-verified resolution with fallback to the
+            # previous committed snapshot (keep-last-2); legacy records
+            # without a "ckpt" key come back as-is for the legacy path.
+            state = _resolve_resume(checkpoint_dir) if state_path else None
+            if state is not None:
                 with obs_run.span(
                     "checkpoint_restore", ckpt=state.get("ckpt", "ckpt")
                 ):
@@ -1163,6 +1229,7 @@ class Word2Vec:
                                 "batches than the agreed per-epoch step count"
                             )
                         break
+                    faults.fire("worker.step")
                     with metrics.timing("host"), metrics.stall_timing(), \
                             obs_run.span("host_batch", epoch=epoch,
                                          group=g):
@@ -1226,14 +1293,19 @@ class Word2Vec:
                     logger.info("stopping early after epoch %d", epoch + 1)
                     break
             # Fit-exit barrier for in-flight async checkpoint writes
-            # (failed writes surface here, loudly).
-            engine.wait_pending_saves()
+            # (failed writes surface here loudly; hung writers raise
+            # after the bounded wait, GLINT_CKPT_WAIT_TIMEOUT).
+            engine.wait_pending_saves(timeout=_ckpt_wait_timeout())
         except TrainingDiverged:
-            engine.wait_pending_saves(reraise=False)
+            engine.wait_pending_saves(
+                reraise=False, timeout=_ckpt_wait_timeout()
+            )
             _save_diverged_snapshot(engine, checkpoint_dir, obs_run)
             raise
         except BaseException:
-            engine.wait_pending_saves(reraise=False)
+            engine.wait_pending_saves(
+                reraise=False, timeout=_ckpt_wait_timeout()
+            )
             obs_run.close(failed=True)
             raise
         finally:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -95,12 +96,25 @@ class TrainingStatus:
         self._engine = engine
         self._recorder = recorder
         self.started = time.time()
-        self.state = "starting"  # starting|running|done|diverged|failed
+        # starting|running|done|diverged|failed|unhealthy
+        self.state = "starting"
         self.epoch = 0
         self.step = 0
         self.words_done = 0
         self.alpha: Optional[float] = None
         self.canary = {"mode": "off", "trips": 0, "last_reason": None}
+        self.unhealthy_reason: Optional[str] = None
+        # Supervisor handshake (parallel/supervisor.py): echo the launch
+        # generation back in every snapshot so the supervisor can tell a
+        # live heartbeat of the CURRENT gang from a stale pre-restart
+        # status file with the same path.
+        gen = os.environ.get("GLINT_SUPERVISOR_GEN")
+        try:
+            self.supervisor_generation = (
+                int(gen) if gen is not None else None
+            )
+        except ValueError:
+            self.supervisor_generation = None
         self._rolling: deque = deque(maxlen=self.ROLLING)
 
     def attach(self, *, metrics=None, engine=None, recorder=None) -> None:
@@ -133,6 +147,16 @@ class TrainingStatus:
                 "mode": mode, "trips": int(trips), "last_reason": last_reason,
             }
 
+    def mark_unhealthy(self, reason: str) -> None:
+        """Flip the worker to ``unhealthy`` so ``/healthz`` answers 503
+        (fleet probes and the supervisor work off status codes, not
+        body parsing). Terminal states already reported (done/diverged/
+        failed) are not downgraded."""
+        with self._mu:
+            if self.state not in ("done", "diverged", "failed"):
+                self.state = "unhealthy"
+            self.unhealthy_reason = str(reason)
+
     def _rolling_wps(self) -> float:
         if len(self._rolling) < 2:
             return 0.0
@@ -154,6 +178,8 @@ class TrainingStatus:
                 "words_per_sec_rolling": round(self._rolling_wps(), 1),
                 "alpha": _finite_or_none(self.alpha),
                 "canary": dict(self.canary),
+                "supervisor_generation": self.supervisor_generation,
+                "unhealthy_reason": self.unhealthy_reason,
             }
         if m is not None:
             # last_loss is whatever the metrics layer last SYNCED — the
@@ -229,7 +255,9 @@ class HeartbeatServer:
                 url = urlparse(self.path)
                 if url.path == "/healthz":
                     snap = server.status.snapshot(include_devices=False)
-                    ok = snap["state"] not in ("diverged", "failed")
+                    ok = snap["state"] not in (
+                        "diverged", "failed", "unhealthy"
+                    )
                     body = json.dumps({
                         "status": "ok" if ok else snap["state"],
                         "state": snap["state"],
@@ -240,8 +268,12 @@ class HeartbeatServer:
                         "words_done": snap["words_done"],
                         "words_per_sec_rolling":
                             snap["words_per_sec_rolling"],
+                        "unhealthy_reason": snap["unhealthy_reason"],
                     }).encode()
-                    self._send(200 if ok else 500, body, "application/json")
+                    # 503, not 500: the probe contract for "this worker
+                    # is unhealthy, act on it" — fleet probes and the
+                    # supervisor branch on the status code alone.
+                    self._send(200 if ok else 503, body, "application/json")
                 elif url.path == "/metrics":
                     snap = server.status.snapshot()
                     fmt = parse_qs(url.query).get("format", ["json"])[0]

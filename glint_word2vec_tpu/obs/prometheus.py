@@ -97,6 +97,9 @@ def training_to_prometheus(snap: dict) -> str:
          "Seconds since the fit's observability run started."),
         ("glint_training_table_version", "table_version",
          "Engine table-mutation counter (serving caches validate on it)."),
+        ("glint_training_supervisor_generation", "supervisor_generation",
+         "Supervisor launch generation echoed by the worker (NaN when "
+         "the fit is unsupervised)."),
         ("glint_training_diverged", None,
          "1 when the divergence canary aborted the run, else 0."),
     ]
@@ -189,6 +192,26 @@ def serving_to_prometheus(snap: dict) -> str:
     p.sample("glint_serving_coalesced_batch_size_bucket", {"le": "+Inf"}, cum)
     p.sample("glint_serving_coalesced_batch_size_sum", None, total)
     p.sample("glint_serving_coalesced_batch_size_count", None, cum)
+    over = snap.get("overload", {})
+    p.head("glint_serving_shed_total", "counter",
+           "Requests shed with 429, by reason (admission = in-flight "
+           "high-water mark; degraded = cache-only mode).")
+    p.sample("glint_serving_shed_total", {"reason": "admission"},
+             over.get("shed_admission_total", 0))
+    p.sample("glint_serving_shed_total", {"reason": "degraded"},
+             over.get("shed_degraded_total", 0))
+    p.head("glint_serving_deadline_hits_total", "counter",
+           "Requests answered 504: deadline passed before the device.")
+    p.sample("glint_serving_deadline_hits_total", None,
+             over.get("deadline_504_total", 0))
+    p.head("glint_serving_degraded_entered_total", "counter",
+           "Transitions into degraded cache-only mode.")
+    p.sample("glint_serving_degraded_entered_total", None,
+             over.get("degraded_entered_total", 0))
+    p.head("glint_serving_inflight_peak", "gauge",
+           "Peak admitted device-touching requests in flight.")
+    p.sample("glint_serving_inflight_peak", None,
+             over.get("inflight_peak", 0))
     cache = snap.get("synonym_cache", {})
     p.head("glint_serving_cache_hits_total", "counter",
            "Synonym result-cache hits.")

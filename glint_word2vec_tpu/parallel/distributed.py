@@ -56,11 +56,30 @@ def initialize(
     Call once per host process, before any other JAX API. No-op if the
     distributed runtime is already initialized.
     """
+    import os
+
     import jax
 
     if _is_initialized(jax):  # already up
         logger.info("jax.distributed already initialized; skipping")
         return
+    # Multi-process CPU runs (the supervisor's gang mode on dev boxes /
+    # CI) need an explicit cross-host collectives backend: without it
+    # jaxlib raises "Multiprocess computations aren't implemented on
+    # the CPU backend" at the first psum. Opt into gloo when the run is
+    # pinned to CPU and the operator hasn't chosen an implementation
+    # (older jax versions without the option just skip this).
+    platforms = (
+        jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    )
+    if (
+        "cpu" in (platforms or "")
+        and "JAX_CPU_COLLECTIVES_IMPLEMENTATION" not in os.environ
+    ):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - jax without the option
+            pass
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

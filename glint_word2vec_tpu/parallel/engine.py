@@ -1711,7 +1711,8 @@ class EmbeddingEngine:
         files, meta = self._snapshot_host(
             self.syn0, self.syn1, mode, deep_copy=False
         )
-        self._write_snapshot(path, files, meta)
+        self._write_snapshot(path, files, meta,
+                             table_version=self.table_version)
 
     # -- non-blocking checkpointing (ISSUE 5) ---------------------------
 
@@ -1756,24 +1757,28 @@ class EmbeddingEngine:
         # bounded to one extra table pair.
         writer.wait_for_slot()
         files, meta = self._snapshot_host(self.syn0, self.syn1, mode)
+        tv = self.table_version
 
         def job():
             with obs_events.span("ckpt_write", ckpt=path):
-                self._write_snapshot(path, files, meta)
+                self._write_snapshot(path, files, meta, table_version=tv)
                 if on_commit is not None:
                     on_commit()
 
-        writer.submit(job)
+        writer.submit(job, label=path)
         return True
 
-    def wait_pending_saves(self, *, reraise: bool = True) -> None:
+    def wait_pending_saves(self, *, reraise: bool = True,
+                           timeout=None) -> None:
         """Barrier: block until no async save is in flight. The fit
         loops run it at fit exit (and implicitly before every state
         flip, since commits are ordered through the single writer);
         ``reraise=False`` is the exception-path variant that must not
-        mask the original failure."""
+        mask the original failure. ``timeout`` (seconds) raises
+        ``utils.async_ckpt.SnapshotWriterHung`` naming the stuck job
+        instead of hanging fit exit forever on a dead filesystem."""
         if self._ckpt_writer is not None:
-            self._ckpt_writer.wait(reraise=reraise)
+            self._ckpt_writer.wait(reraise=reraise, timeout=timeout)
 
     def checkpoint_stats(self) -> dict:
         """Checkpoint telemetry for the heartbeat / serving snapshots:
@@ -1929,22 +1934,27 @@ class EmbeddingEngine:
             "shared_negatives": self.shared_negatives,
         }
 
-    def _write_snapshot(self, path: str, files, meta: dict) -> None:
+    def _write_snapshot(self, path: str, files, meta: dict,
+                        table_version=None) -> None:
         """Serialize a host snapshot to disk with a crash-safe commit.
 
         Fresh ``path`` (every checkpoint dir): everything lands in a
         sibling temp directory first — each file fsync'd, so the rename
         can never commit a checkpoint whose bytes are still only in the
         page cache (a power loss after the rename must not roll the
-        DATA back) — then ONE atomic rename makes the whole snapshot
-        appear, followed by a parent-directory fsync to make the rename
-        itself durable. A kill at any earlier point leaves only an
-        unreferenced ``*.tmp-*`` directory (pruned by the next state
-        flip). ``GLINT_CKPT_NO_FSYNC=1`` skips the fsyncs (fast local
-        scratch / tests). Existing ``path`` (re-saving a model dir in
-        place): each file goes through temp + ``os.replace`` and the
-        ``engine.json`` manifest is written last, so no file is ever
-        truncated."""
+        DATA back) — plus a ``manifest.json`` (per-file sha256 + sizes +
+        ``table_version``, utils/integrity.py) so the committed
+        directory is verifiable end to end — then ONE atomic rename
+        makes the whole snapshot appear, followed by a parent-directory
+        fsync to make the rename itself durable. A kill at any earlier
+        point leaves only an unreferenced ``*.tmp-*`` directory (pruned
+        by the next state flip). ``GLINT_CKPT_NO_FSYNC=1`` skips the
+        fsyncs (fast local scratch / tests). Existing ``path``
+        (re-saving a model dir in place): each file goes through temp +
+        ``os.replace`` with ``engine.json`` after the data files and the
+        integrity manifest last, so no file is ever truncated."""
+        from glint_word2vec_tpu.utils import faults, integrity
+
         t0 = time.time()
         fsync = os.environ.get("GLINT_CKPT_NO_FSYNC", "0") != "1"
         if not os.path.exists(path):
@@ -1965,11 +1975,22 @@ class EmbeddingEngine:
                 if fsync:
                     f.flush()
                     os.fsync(f.fileno())
+            integrity.write_manifest(
+                tmp,
+                integrity.build_manifest(
+                    tmp,
+                    [fname for fname, _ in files] + ["engine.json"],
+                    table_version,
+                ),
+                fsync=fsync,
+            )
             if fsync:
                 # The dirents too, not just the file data: fsync(file)
                 # alone need not persist the entry in its directory.
                 self._fsync_dir(tmp)
+            faults.fire("ckpt.pre_rename")
             self._commit_snapshot_dir(tmp, path)
+            faults.fire("ckpt.post_rename")
             if fsync:
                 self._fsync_dir(os.path.dirname(os.path.abspath(path)))
         else:
@@ -1993,6 +2014,15 @@ class EmbeddingEngine:
             _put(
                 "engine.json",
                 lambda f: f.write(json.dumps(meta).encode()),
+            )
+            integrity.write_manifest(
+                path,
+                integrity.build_manifest(
+                    path,
+                    [fname for fname, _ in files] + ["engine.json"],
+                    table_version,
+                ),
+                fsync=fsync,
             )
             if fsync:
                 self._fsync_dir(os.path.abspath(path))
@@ -2064,6 +2094,14 @@ class EmbeddingEngine:
         if jax.process_index() == 0:
             with open(os.path.join(path, "engine.json"), "w") as f:
                 json.dump(meta, f)
+            # No integrity manifest on the multi-host in-place path (no
+            # single writer sees every shard file); drop any stale one a
+            # previous single-process save left so verification can't
+            # reject the fresh shards against old hashes.
+            try:
+                os.remove(os.path.join(path, "manifest.json"))
+            except OSError:
+                pass
 
     def _counts_unpadded(self) -> np.ndarray:
         # Recover counts from the alias table is lossy; engines keep them.
@@ -2101,12 +2139,23 @@ class EmbeddingEngine:
         eng.load_tables(path)
         return eng
 
-    def load_tables(self, path: str) -> None:
+    def load_tables(self, path: str, *, verify: bool = True) -> None:
         """Install table values from a :meth:`save` directory (either
         format) into this engine, re-sharding to its mesh. Each device
         shard is assembled independently from the overlapping source row
         blocks (mmap-sliced), so peak host memory is one shard, not one
-        table."""
+        table.
+
+        ``verify`` (default on) checks the directory against its
+        ``manifest.json`` first — sizes + sha256 of every file — and
+        raises ``utils.integrity.CheckpointCorruptError`` on mismatch
+        or a partial directory, so bit rot can never load silently.
+        Legacy directories with no manifest load unverified;
+        ``GLINT_CKPT_NO_VERIFY=1`` downgrades to size-only checks."""
+        if verify:
+            from glint_word2vec_tpu.utils import integrity
+
+            integrity.verify_snapshot_dir(path)
         with open(os.path.join(path, "engine.json")) as f:
             meta = json.load(f)
         if (meta["vocab_size"], meta.get("extra_rows", 0)) != (

@@ -1,0 +1,447 @@
+"""Elastic training supervisor: gang launch, liveness, teardown, resume.
+
+The reference gets its fault model for free from Akka — the Glint master
+supervises server/worker actors, and a died actor is restarted by its
+supervisor while pull/push round-trips retry under timeouts
+(SURVEY.md §2.2). Our multi-process fits had the opposite property: SPMD
+lockstep means ONE dead or wedged worker parks every surviving process in
+a collective forever, and PR 5's crash-safe checkpoints only helped if an
+operator noticed and relaunched by hand. This module is the active half:
+
+  * launches the N-process gang for a distributed fit (fresh coordinator
+    port per generation — a half-dead coordinator must never be rejoined);
+  * watches liveness two ways: ``waitpid`` (crash — any worker exiting
+    nonzero or on a signal) and the PR 3 ``--status-file`` heartbeat
+    snapshots (hang — a status file of the current generation whose
+    mtime goes stale while its process still runs);
+  * on any failure tears the WHOLE gang down (SIGTERM, grace, SIGKILL —
+    survivors are wedged in collectives and cannot make progress),
+    re-resolves the last committed checkpoint through the integrity
+    verifier (``utils.integrity.resolve_train_state`` — corrupt newest
+    snapshot falls back to the kept previous one), and relaunches with
+    capped exponential backoff under a max-restarts budget;
+  * hands back a :class:`SupervisorReport` with restart counts and
+    per-restart recovery latencies — the numbers ``scripts/chaos_drill.py``
+    records into FAULT_BENCH.json.
+
+Generation handshake: each launch exports ``GLINT_SUPERVISOR_GEN``; the
+worker's heartbeat snapshot echoes it back as ``supervisor_generation``
+(obs/heartbeat.py), so the supervisor never mistakes a pre-restart
+status file for a live heartbeat of the current gang.
+
+Single-process "gangs" (num_workers=1) are the degenerate case and fully
+supported: the supervisor is then a restart-with-resume wrapper around
+one fit.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: build_argv(rank, num_workers, coordinator_port, status_file,
+#: generation) -> argv list for one worker process.
+BuildArgv = Callable[[int, int, int, str, int], List[str]]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cli_train_build_argv(train_rest: List[str]) -> BuildArgv:
+    """:data:`BuildArgv` for workers running ``python -m
+    glint_word2vec_tpu.cli train <train_rest>`` — the ONE place the
+    worker launch contract (per-rank status file, distributed flags for
+    gangs > 1) is encoded, shared by the CLI ``supervise`` subcommand
+    and ``scripts/chaos_drill.py``."""
+    import sys
+
+    def build_argv(rank, n, port, status_file, generation):
+        argv = [
+            sys.executable, "-m", "glint_word2vec_tpu.cli", "train",
+            *train_rest, "--status-file", status_file,
+        ]
+        if n > 1:
+            argv += [
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", str(n), "--process-id", str(rank),
+            ]
+        return argv
+
+    return build_argv
+
+
+@dataclass
+class RestartRecord:
+    generation: int  # the generation that FAILED
+    reason: str
+    resumed_from: Optional[str]  # verified checkpoint name, None = fresh
+    backoff_seconds: float
+    detect_to_relaunch_seconds: float
+    #: Detection -> first heartbeat snapshot of the NEW generation (the
+    #: honest recovery latency: includes backoff, jax bring-up, vocab
+    #: rebuild, checkpoint restore). None when no heartbeat arrived
+    #: before the run ended (very short tails).
+    detect_to_heartbeat_seconds: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "reason": self.reason,
+            "resumed_from": self.resumed_from,
+            "backoff_seconds": round(self.backoff_seconds, 3),
+            "detect_to_relaunch_seconds": round(
+                self.detect_to_relaunch_seconds, 3
+            ),
+            "detect_to_heartbeat_seconds": (
+                round(self.detect_to_heartbeat_seconds, 3)
+                if self.detect_to_heartbeat_seconds is not None else None
+            ),
+        }
+
+
+@dataclass
+class SupervisorReport:
+    completed: bool = False
+    restarts: int = 0
+    generations: int = 0
+    gave_up_reason: Optional[str] = None
+    wall_seconds: float = 0.0
+    restart_records: List[RestartRecord] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "completed": self.completed,
+            "restarts": self.restarts,
+            "generations": self.generations,
+            "gave_up_reason": self.gave_up_reason,
+            "wall_seconds": round(self.wall_seconds, 2),
+            "restart_records": [r.to_dict() for r in self.restart_records],
+        }
+
+
+class Supervisor:
+    """Supervise one N-worker training gang to completion.
+
+    Parameters
+    ----------
+    build_argv:
+        Callable producing each worker's argv (see :data:`BuildArgv`).
+        The CLI ``supervise`` subcommand builds these from the raw
+        ``train`` arguments; tests pass tiny stub scripts.
+    num_workers:
+        Gang size. 1 supervises a plain single-process fit.
+    status_dir:
+        Directory for per-rank status files (``status-<rank>.json``) and
+        worker logs (``worker-<rank>.log``, appended across generations).
+    checkpoint_dir:
+        The fit's checkpoint directory; consulted between generations to
+        log (and integrity-verify) what the relaunch will resume from.
+        None skips re-resolution (the workers still resume themselves).
+    env:
+        Extra environment for every launch of every rank.
+    rank_env_first_launch:
+        Extra environment per rank applied ONLY to generation 0 — the
+        chaos-drill seam: a ``GLINT_FAULTS`` kill schedule armed here
+        fires once and is NOT re-armed on the relaunch (re-arming would
+        kill every generation and burn the whole restart budget).
+    heartbeat_stale_seconds:
+        A current-generation status file older than this while its
+        process lives is a hang. None disables hang detection (crash
+        detection alone).
+    startup_grace_seconds:
+        How long a worker may run without producing its first
+        current-generation heartbeat before that too is a hang
+        (compilation can take minutes on cold starts — keep generous).
+    """
+
+    def __init__(
+        self,
+        build_argv: BuildArgv,
+        num_workers: int,
+        *,
+        status_dir: str,
+        checkpoint_dir: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        rank_env_first_launch: Optional[Dict[int, Dict[str, str]]] = None,
+        heartbeat_stale_seconds: Optional[float] = 120.0,
+        startup_grace_seconds: float = 600.0,
+        poll_interval: float = 0.25,
+        max_restarts: int = 3,
+        backoff_base_seconds: float = 1.0,
+        backoff_cap_seconds: float = 30.0,
+        kill_grace_seconds: float = 5.0,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.build_argv = build_argv
+        self.num_workers = int(num_workers)
+        self.status_dir = status_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.env = dict(env or {})
+        self.rank_env_first_launch = dict(rank_env_first_launch or {})
+        self.heartbeat_stale_seconds = heartbeat_stale_seconds
+        self.startup_grace_seconds = float(startup_grace_seconds)
+        self.poll_interval = float(poll_interval)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_seconds = float(backoff_base_seconds)
+        self.backoff_cap_seconds = float(backoff_cap_seconds)
+        self.kill_grace_seconds = float(kill_grace_seconds)
+        self._procs: List[Optional[subprocess.Popen]] = []
+        self._logs: List = []
+
+    # -- per-generation plumbing ----------------------------------------
+
+    def _status_file(self, rank: int) -> str:
+        return os.path.join(self.status_dir, f"status-{rank}.json")
+
+    def _read_status(self, rank: int, generation: int) -> Optional[dict]:
+        """The rank's status snapshot, or None if absent/unparseable/
+        from a previous generation (the handshake: a stale pre-restart
+        file must never count as a live heartbeat)."""
+        try:
+            with open(self._status_file(rank)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return None
+        gen = snap.get("supervisor_generation")
+        if gen is not None and int(gen) != generation:
+            return None
+        return snap
+
+    def _launch(self, generation: int) -> None:
+        os.makedirs(self.status_dir, exist_ok=True)
+        port = free_port()
+        self._procs, self._logs = [], []
+        for rank in range(self.num_workers):
+            sf = self._status_file(rank)
+            try:
+                os.remove(sf)
+            except OSError:
+                pass
+            env = dict(os.environ)
+            env.update(self.env)
+            env["GLINT_SUPERVISOR"] = "1"
+            env["GLINT_SUPERVISOR_GEN"] = str(generation)
+            if generation == 0:
+                env.update(self.rank_env_first_launch.get(rank, {}))
+            argv = self.build_argv(
+                rank, self.num_workers, port, sf, generation
+            )
+            log = open(
+                os.path.join(self.status_dir, f"worker-{rank}.log"), "ab"
+            )
+            log.write(
+                f"\n===== generation {generation} rank {rank}: "
+                f"{' '.join(argv)} =====\n".encode()
+            )
+            log.flush()
+            self._logs.append(log)
+            # Own session per worker: the gang teardown kills the whole
+            # process group, catching any grandchildren, and an operator
+            # Ctrl-C on the supervisor doesn't race the workers.
+            self._procs.append(
+                subprocess.Popen(
+                    argv, env=env, stdout=log, stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+            )
+        logger.info(
+            "supervisor: generation %d launched (%d workers, "
+            "coordinator port %d)", generation, self.num_workers, port,
+        )
+
+    def _kill_gang(self) -> None:
+        """SIGTERM every live worker's process group, grace, SIGKILL.
+        Survivors of a partial failure are wedged in collectives — they
+        cannot checkpoint or exit cleanly, so the teardown must not
+        wait on their goodwill."""
+        live = [p for p in self._procs if p is not None and p.poll() is None]
+        for p in live:
+            self._signal(p, signal.SIGTERM)
+        deadline = time.time() + self.kill_grace_seconds
+        while time.time() < deadline and any(
+            p.poll() is None for p in live
+        ):
+            time.sleep(0.05)
+        for p in live:
+            if p.poll() is None:
+                self._signal(p, signal.SIGKILL)
+        for p in live:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                logger.error(
+                    "supervisor: worker pid %d survived SIGKILL", p.pid
+                )
+        for log in self._logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+        self._logs = []
+
+    @staticmethod
+    def _signal(proc: subprocess.Popen, sig) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    # -- failure detection ----------------------------------------------
+
+    def _check_failure(
+        self, generation: int, launched_at: float
+    ) -> Optional[str]:
+        """One poll round: returns a failure reason, or None while the
+        generation is healthy (or already fully done — the caller checks
+        completion first)."""
+        now = time.time()
+        for rank, p in enumerate(self._procs):
+            rc = p.poll()
+            if rc is not None and rc != 0:
+                if rc < 0:
+                    try:
+                        name = signal.Signals(-rc).name
+                    except ValueError:
+                        name = str(-rc)
+                    return f"worker {rank} killed by signal {name}"
+                return f"worker {rank} exited with code {rc}"
+        if self.heartbeat_stale_seconds is None:
+            return None
+        for rank, p in enumerate(self._procs):
+            if p.poll() == 0:
+                continue  # finished cleanly; its file legitimately ages
+            snap = self._read_status(rank, generation)
+            if snap is None:
+                if now - launched_at > self.startup_grace_seconds:
+                    return (
+                        f"worker {rank} produced no generation-"
+                        f"{generation} heartbeat within "
+                        f"{self.startup_grace_seconds:.0f}s"
+                    )
+                continue
+            age = now - os.path.getmtime(self._status_file(rank))
+            if age > self.heartbeat_stale_seconds:
+                return (
+                    f"worker {rank} heartbeat stale for {age:.1f}s "
+                    f"(threshold {self.heartbeat_stale_seconds:.0f}s)"
+                )
+        return None
+
+    def _resolve_checkpoint(self) -> Optional[str]:
+        """Integrity-verified name of the snapshot the relaunch will
+        resume from (None = fresh start). Raises
+        ``CheckpointCorruptError`` when a state file exists but nothing
+        verifies — restarting would silently retrain from scratch."""
+        if not self.checkpoint_dir:
+            return None
+        from glint_word2vec_tpu.utils.integrity import resolve_train_state
+
+        resolved = resolve_train_state(self.checkpoint_dir)
+        if resolved is None:
+            return None
+        state, _ = resolved
+        return state.get("ckpt")  # legacy records carry no dir name
+
+    # -- main loop ------------------------------------------------------
+
+    def run(self) -> SupervisorReport:
+        report = SupervisorReport()
+        t0 = time.time()
+        generation = 0
+        pending_hb: Optional[RestartRecord] = None
+        hb_detect_t = 0.0
+        try:
+            self._launch(generation)
+            report.generations = 1
+            launched_at = time.time()
+            while True:
+                if all(p.poll() == 0 for p in self._procs):
+                    report.completed = True
+                    logger.info(
+                        "supervisor: generation %d completed (%d "
+                        "restarts total)", generation, report.restarts,
+                    )
+                    return report
+                if pending_hb is not None and any(
+                    self._read_status(r, generation) is not None
+                    for r in range(self.num_workers)
+                ):
+                    pending_hb.detect_to_heartbeat_seconds = (
+                        time.time() - hb_detect_t
+                    )
+                    pending_hb = None
+                reason = self._check_failure(generation, launched_at)
+                if reason is None:
+                    time.sleep(self.poll_interval)
+                    continue
+
+                detect_t = time.time()
+                logger.error(
+                    "supervisor: generation %d FAILED: %s; tearing the "
+                    "gang down", generation, reason,
+                )
+                self._kill_gang()
+                if report.restarts >= self.max_restarts:
+                    report.gave_up_reason = (
+                        f"{reason} (restart budget {self.max_restarts} "
+                        "exhausted)"
+                    )
+                    logger.error(
+                        "supervisor: giving up: %s", report.gave_up_reason
+                    )
+                    return report
+                try:
+                    resumed_from = self._resolve_checkpoint()
+                except Exception as e:
+                    report.gave_up_reason = (
+                        f"{reason}; no verifiable checkpoint to resume "
+                        f"from: {e}"
+                    )
+                    logger.error(
+                        "supervisor: giving up: %s", report.gave_up_reason
+                    )
+                    return report
+                backoff = min(
+                    self.backoff_base_seconds * (2 ** report.restarts),
+                    self.backoff_cap_seconds,
+                )
+                logger.warning(
+                    "supervisor: restart %d/%d in %.1fs (resuming from "
+                    "%s)", report.restarts + 1, self.max_restarts,
+                    backoff, resumed_from or "scratch",
+                )
+                time.sleep(backoff)
+                generation += 1
+                self._launch(generation)
+                launched_at = time.time()
+                report.restarts += 1
+                report.generations += 1
+                rec = RestartRecord(
+                    generation=generation - 1,
+                    reason=reason,
+                    resumed_from=resumed_from,
+                    backoff_seconds=backoff,
+                    detect_to_relaunch_seconds=time.time() - detect_t,
+                )
+                report.restart_records.append(rec)
+                pending_hb, hb_detect_t = rec, detect_t
+        finally:
+            self._kill_gang()
+            report.wall_seconds = time.time() - t0
+        return report

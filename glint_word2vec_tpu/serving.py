@@ -35,6 +35,14 @@ shape family: coalesced batches pad to power-of-two Q buckets (capped at
 compiles the whole family BEFORE binding the port — so the first real
 request (and every later one inside the family) never pays a jit compile.
 
+Overload protection (ISSUE 7): device-touching requests are bounded by
+an admission high-water mark (shed with 429 + ``Retry-After`` past
+``max_inflight``), carry a per-request deadline answered with 504
+instead of occupying a dispatch slot, and while the device lock is held
+past ``degraded_after`` the server runs a degraded cache-only mode —
+cache hits served, misses shed with 429. Shed/deadline/degraded
+counters are on ``/metrics`` in both renderers.
+
 Start from the CLI:  glint-word2vec-tpu serve --model DIR --port 8801
 """
 
@@ -52,10 +60,64 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from glint_word2vec_tpu.obs.prometheus import serving_to_prometheus
-from glint_word2vec_tpu.utils import next_pow2
+from glint_word2vec_tpu.utils import faults, next_pow2
 from glint_word2vec_tpu.utils.metrics import ServingMetrics
 
 logger = logging.getLogger(__name__)
+
+#: Endpoints whose requests touch the device (or wait on the device
+#: lock) — the population the admission bound, per-request deadlines,
+#: and degraded mode govern. /healthz, /metrics, /shutdown stay exempt:
+#: an overloaded server must still be probeable and stoppable.
+_DEVICE_PATHS = frozenset(
+    ("/synonyms", "/synonyms_vector", "/analogy", "/vector", "/transform")
+)
+
+
+class DeadlineExceeded(Exception):
+    """A request's deadline passed before (or while) it could reach the
+    device — answered 504 so the client's own timeout budget, not the
+    server's queue depth, bounds its wait."""
+
+
+class _TrackedLock:
+    """``threading.Lock`` that remembers when it was acquired, so the
+    overload layer can observe "the device has been busy for X seconds"
+    without instrumenting every dispatch site. API-compatible with the
+    plain lock for ``with`` use; ``acquire`` grows a timeout."""
+
+    __slots__ = ("_lock", "_held_since")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._held_since: Optional[float] = None
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            ok = self._lock.acquire()
+        else:
+            ok = self._lock.acquire(timeout=max(0.0, timeout))
+        if ok:
+            self._held_since = time.monotonic()
+        return ok
+
+    def release(self) -> None:
+        self._held_since = None
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def held_for(self) -> float:
+        """Seconds the lock has been continuously held; 0.0 when free.
+        Reads a single attribute — safe (and deliberately lock-free)
+        from any thread; a racing release just reads as 0.0."""
+        hs = self._held_since
+        return 0.0 if hs is None else time.monotonic() - hs
 
 
 def _pull_coalesced(engine, idx: np.ndarray) -> np.ndarray:
@@ -147,14 +209,39 @@ class _SynonymCoalescer:
             and type(model).transform is Word2VecModel.transform
         )
 
-    def query(self, word=None, vector=None, num: int = 10):
+    def _acquire_device(self, deadline: Optional[float]) -> bool:
+        """Take the device lock, bounded by the request deadline: a
+        request that cannot reach the device in time must answer 504
+        WITHOUT ever occupying a dispatch slot."""
+        if deadline is None:
+            return self.device_lock.acquire()
+        return self.device_lock.acquire(
+            timeout=deadline - time.monotonic()
+        )
+
+    def cache_lookup(self, word, num):
+        """Result-cache probe with NO device work — the degraded
+        cache-only mode's read path. Returns the cached hit list or
+        None; never blocks on the device lock."""
+        if word is None or not self.cache_size:
+            return None
+        with self._mu:
+            self._cache_sync_locked()
+            return self._cache.get((word, int(num)))
+
+    def query(self, word=None, vector=None, num: int = 10,
+              deadline: Optional[float] = None):
         if not self.can_batch:
             # Overriding families define their own semantics end to end
             # (FastText OOV-by-subwords, its own num validation).
-            with self.device_lock:
+            if not self._acquire_device(deadline):
+                raise DeadlineExceeded("deadline waiting for device")
+            try:
                 if word is not None:
                     return self.model.find_synonyms(word, num)
                 return self.model.find_synonyms_vector(vector, num)
+            finally:
+                self.device_lock.release()
         if num <= 0:
             # Exact single-query behavior for the base family.
             # find_synonyms(w, num): transform(w) runs FIRST (OOV ->
@@ -179,6 +266,7 @@ class _SynonymCoalescer:
         req = {
             "word": word, "vector": vector, "num": int(num),
             "event": threading.Event(), "result": None, "error": None,
+            "deadline": deadline, "abandoned": False,
         }
         with self._mu:
             self._pending.append(req)
@@ -187,30 +275,52 @@ class _SynonymCoalescer:
         # queue behind the next leader's whole dispatch (lock convoy —
         # it showed up as a 7x p95 inflation at 16 clients).
         if not req["event"].is_set():
-            with self.device_lock:
+            if self._acquire_device(deadline):
+                try:
+                    if not req["event"].is_set():
+                        with self._mu:
+                            batch, self._pending = self._pending, []
+                        if len(batch) > 1 and self.batch_grace > 0:
+                            # Concurrency detected: absorb stragglers
+                            # until one quiet grace window (or the chunk
+                            # cap) so the whole round rides one bucketed
+                            # dispatch. A request missing the drain
+                            # costs a FULL extra device round; the
+                            # worst-case grace (16ms) is well under one.
+                            for _ in range(8):
+                                n0 = len(batch)
+                                time.sleep(self.batch_grace)
+                                with self._mu:
+                                    if self._pending:
+                                        batch += self._pending
+                                        self._pending = []
+                                if (len(batch) == n0
+                                        or len(batch) >= self.max_batch):
+                                    break
+                        if batch:
+                            self._process(batch)
+                finally:
+                    self.device_lock.release()
+        if deadline is None:
+            req["event"].wait()
+        elif not req["event"].wait(deadline - time.monotonic()):
+            # Timed out waiting for a leader. Mark the request abandoned
+            # AND pull it out of the pending list under the lock, so the
+            # list cannot grow without bound while the device is wedged
+            # (no future leader may ever drain it) and a future leader
+            # that does run spends no dispatch work on a client that
+            # already got its 504. If the result landed in the race,
+            # serve it.
+            with self._mu:
                 if not req["event"].is_set():
-                    with self._mu:
-                        batch, self._pending = self._pending, []
-                    if len(batch) > 1 and self.batch_grace > 0:
-                        # Concurrency detected: absorb stragglers until
-                        # one quiet grace window (or the chunk cap) so
-                        # the whole round rides one bucketed dispatch.
-                        # A request missing the drain costs a FULL extra
-                        # device round; the worst-case grace (16ms) is
-                        # well under one.
-                        for _ in range(8):
-                            n0 = len(batch)
-                            time.sleep(self.batch_grace)
-                            with self._mu:
-                                if self._pending:
-                                    batch += self._pending
-                                    self._pending = []
-                            if (len(batch) == n0
-                                    or len(batch) >= self.max_batch):
-                                break
-                    if batch:
-                        self._process(batch)
-        req["event"].wait()
+                    req["abandoned"] = True
+                    try:
+                        self._pending.remove(req)
+                    except ValueError:
+                        pass  # a leader already drained it
+            if req["abandoned"]:
+                raise DeadlineExceeded("deadline waiting for dispatch")
+            req["event"].wait()
         if req["error"] is not None:
             raise req["error"]
         return req["result"]
@@ -228,7 +338,22 @@ class _SynonymCoalescer:
     def _process(self, batch) -> None:
         m = self.model
         live = []
+        now = time.monotonic()
         for r in batch:
+            # Dead requests first: an abandoned waiter already answered
+            # 504, and one whose deadline passed while queued must not
+            # consume dispatch work either — its waiter raises
+            # DeadlineExceeded from the recorded error.
+            if r.get("abandoned"):
+                r["event"].set()
+                continue
+            dl = r.get("deadline")
+            if dl is not None and now > dl:
+                r["error"] = DeadlineExceeded(
+                    "deadline exceeded before dispatch"
+                )
+                r["event"].set()
+                continue
             # Validation failures must fail ONLY their own request: an
             # exception escaping here would strand every co-batched
             # waiter on an event that never fires.
@@ -273,6 +398,7 @@ class _SynonymCoalescer:
     def _dispatch(self, chunk) -> None:
         """Answer one <= max_batch slice of the drained batch with one
         bucketed pull + one bucketed batch top-k dispatch."""
+        faults.fire("serving.dispatch")
         m = self.model
         # Version BEFORE the reads: if a table mutation lands mid-
         # dispatch these results are from the old tables and must not
@@ -341,6 +467,9 @@ class ModelServer:
         warm_sentence_lens=(1, 2, 4, 8, 16, 32, 64),
         warm_sentence_rows=(1, 2, 4, 8, 16),
         cache_size: int = 65536,
+        max_inflight: int = 256,
+        request_deadline: Optional[float] = 30.0,
+        degraded_after: Optional[float] = 5.0,
     ):
         self.model = model
         self._prev_switch: Optional[float] = None
@@ -348,9 +477,31 @@ class ModelServer:
         # them (the reference's PS likewise processes a shard's requests
         # on its actor mailbox, one at a time). The synonym endpoints
         # additionally coalesce concurrent waiters into one batched
-        # dispatch (_SynonymCoalescer).
-        self._lock = threading.Lock()
+        # dispatch (_SynonymCoalescer). Tracked so the overload layer
+        # can see how long the device has been continuously busy.
+        self._lock = _TrackedLock()
         self.metrics = ServingMetrics()
+        # -- overload protection (ISSUE 7) -----------------------------
+        #: Admission high-water mark: device-touching requests past this
+        #: many in flight shed with 429 + Retry-After instead of
+        #: queueing without bound (the _pending list and the handler
+        #: thread pool both used to grow arbitrarily under overload).
+        self.max_inflight = max(0, int(max_inflight))
+        #: Per-request deadline (seconds; None/0 disables): a request
+        #: that cannot reach the device in time answers 504 without
+        #: occupying a dispatch slot.
+        self.request_deadline = (
+            float(request_deadline) if request_deadline else None
+        )
+        #: Device-lock hold time (seconds; None/0 disables) past which
+        #: the server enters degraded cache-only mode: cache hits are
+        #: served, everything needing the device sheds with 429.
+        self.degraded_after = (
+            float(degraded_after) if degraded_after else None
+        )
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self._degraded_flag = False
         self._coalescer = _SynonymCoalescer(
             model, self._lock, max_batch=max_batch, metrics=self.metrics,
             cache_size=cache_size,
@@ -378,12 +529,14 @@ class ModelServer:
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 logger.debug("serve: " + fmt, *args)
 
-            def _send(self, code: int, obj) -> None:
+            def _send(self, code: int, obj, headers=None) -> None:
                 body = json.dumps(obj).encode()
                 self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -410,10 +563,17 @@ class ModelServer:
                     if url.path == "/healthz":
                         m = server.model
                         compiles = server._query_compiles()
+                        degraded = server._degraded()
                         self._send(
+                            # Degraded is still alive-but-impaired: 200
+                            # with the flag (a 5xx here would make the
+                            # fleet LB pull a server that is shedding
+                            # exactly as designed).
                             200,
                             {
-                                "status": "ok",
+                                "status": (
+                                    "degraded" if degraded else "ok"
+                                ),
                                 "family": type(m).__name__,
                                 "vocab_size": m.vocab.size,
                                 "dim": m.vector_size,
@@ -421,6 +581,11 @@ class ModelServer:
                                 "compiles": compiles,
                                 "post_warmup_compiles": compiles
                                 - server.metrics.warmup_compiles,
+                                "max_inflight": server.max_inflight,
+                                "request_deadline_seconds":
+                                    server.request_deadline,
+                                "degraded_after_seconds":
+                                    server.degraded_after,
                             },
                         )
                     elif url.path == "/metrics":
@@ -459,6 +624,71 @@ class ModelServer:
                     req = json.loads(self.rfile.read(n) or b"{}")
                 except (ValueError, json.JSONDecodeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
+                if path in _DEVICE_PATHS:
+                    # Admission bound: past the high-water mark the
+                    # request sheds NOW — cheaper for everyone than
+                    # joining a queue whose wait already exceeds any
+                    # reasonable client timeout.
+                    if not server._admit():
+                        server.metrics.record_shed("admission")
+                        return self._send(
+                            429,
+                            {"error": "server overloaded "
+                                      "(admission queue full)"},
+                            headers={"Retry-After": "1"},
+                        )
+                    try:
+                        return self._handle_device(path, req)
+                    finally:
+                        server._release_slot()
+                out = None
+                if path == "/shutdown":
+                    with server._lock:
+                        out = server._dispatch(path, req)
+                    self._send(200, out)
+                    threading.Thread(
+                        target=server.stop, daemon=True
+                    ).start()
+                    return
+                self._send(404, {"error": f"no route {path}"})
+
+            def _handle_device(self, path, req):
+                """One admitted device-touching request: degraded-mode
+                gate, per-request deadline, then dispatch."""
+                if server._degraded():
+                    # Cache-only mode: the device is wedged — serve
+                    # what needs no dispatch, shed the rest. 429 (not
+                    # 5xx): the condition is load/availability, the
+                    # client should back off and retry.
+                    if path == "/synonyms":
+                        try:
+                            num = int(req.get("num", 10))
+                        except (TypeError, ValueError) as e:
+                            # Same 400 contract as the normal path — a
+                            # malformed num must not change behavior
+                            # just because the server is impaired.
+                            return self._send(
+                                400, {"error": f"bad num: {e}"}
+                            )
+                        hit = server._coalescer.cache_lookup(
+                            req.get("word"), num
+                        )
+                        if hit is not None:
+                            server.metrics.record_cache(True)
+                            return self._send(
+                                200, [[w, float(s)] for w, s in hit]
+                            )
+                    server.metrics.record_shed("degraded")
+                    return self._send(
+                        429,
+                        {"error": "degraded cache-only mode "
+                                  "(device busy)"},
+                        headers={"Retry-After": "1"},
+                    )
+                deadline = (
+                    time.monotonic() + server.request_deadline
+                    if server.request_deadline else None
+                )
                 try:
                     if path == "/synonyms":
                         out = [
@@ -466,6 +696,7 @@ class ModelServer:
                             for w, s in server._coalescer.query(
                                 word=req["word"],
                                 num=int(req.get("num", 10)),
+                                deadline=deadline,
                             )
                         ]
                     elif path == "/synonyms_vector":
@@ -474,11 +705,27 @@ class ModelServer:
                             for w, s in server._coalescer.query(
                                 vector=req["vector"],
                                 num=int(req.get("num", 10)),
+                                deadline=deadline,
                             )
                         ]
                     else:
-                        with server._lock:
+                        if deadline is None:
+                            acquired = server._lock.acquire()
+                        else:
+                            acquired = server._lock.acquire(
+                                timeout=deadline - time.monotonic()
+                            )
+                        if not acquired:
+                            raise DeadlineExceeded(
+                                "deadline waiting for device"
+                            )
+                        try:
                             out = server._dispatch(path, req)
+                        finally:
+                            server._lock.release()
+                except DeadlineExceeded as e:
+                    server.metrics.record_deadline()
+                    return self._send(504, {"error": str(e)})
                 except KeyError as e:
                     return self._send(
                         404, {"error": e.args[0] if e.args else str(e)}
@@ -488,12 +735,51 @@ class ModelServer:
                 if out is None:
                     return self._send(404, {"error": f"no route {path}"})
                 self._send(200, out)
-                if path == "/shutdown":
-                    threading.Thread(target=server.stop, daemon=True).start()
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    # -- overload protection ------------------------------------------
+
+    def _admit(self) -> bool:
+        """Claim one in-flight slot for a device-touching request;
+        False = past the high-water mark, shed with 429."""
+        if not self.max_inflight:
+            return True
+        with self._inflight_mu:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            self.metrics.record_inflight(self._inflight)
+            return True
+
+    def _release_slot(self) -> None:
+        if not self.max_inflight:
+            return
+        with self._inflight_mu:
+            self._inflight -= 1
+
+    def _degraded(self) -> bool:
+        """Whether the server is in degraded cache-only mode: the
+        device lock has been continuously held past ``degraded_after``
+        (a wedged or pathologically slow dispatch). Tracks entry
+        transitions for the ``degraded_entered`` counter; exits
+        automatically the moment the lock frees."""
+        if self.degraded_after is None:
+            return False
+        d = self._lock.held_for() > self.degraded_after
+        with self._inflight_mu:
+            if d and not self._degraded_flag:
+                self._degraded_flag = True
+                self.metrics.record_degraded_entered()
+                logger.warning(
+                    "entering degraded cache-only mode: device lock "
+                    "held > %.1fs", self.degraded_after,
+                )
+            elif not d:
+                self._degraded_flag = False
+        return d
 
     # -- warmup / compile accounting ----------------------------------
 
@@ -550,6 +836,8 @@ class ModelServer:
     # -- request dispatch ---------------------------------------------
 
     def _dispatch(self, path: str, req: dict):
+        if path != "/shutdown":
+            faults.fire("serving.dispatch")
         m = self.model
         if path == "/analogy":
             return [
@@ -612,6 +900,9 @@ def serve_model_dir(
     max_batch: int = 64,
     warmup: bool = True,
     cache_size: int = 65536,
+    max_inflight: int = 256,
+    request_deadline: Optional[float] = 30.0,
+    degraded_after: Optional[float] = 5.0,
 ) -> None:
     """Load a saved model (any family) and serve it until killed."""
     from glint_word2vec_tpu import load_model
@@ -619,6 +910,8 @@ def serve_model_dir(
     server = ModelServer(
         load_model(model_dir), host=host, port=port,
         max_batch=max_batch, warmup=warmup, cache_size=cache_size,
+        max_inflight=max_inflight, request_deadline=request_deadline,
+        degraded_after=degraded_after,
     )
     try:
         server.serve_forever()

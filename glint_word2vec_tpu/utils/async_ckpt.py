@@ -37,6 +37,13 @@ from typing import Callable, Optional
 logger = logging.getLogger(__name__)
 
 
+class SnapshotWriterHung(RuntimeError):
+    """A wait on the background checkpoint writer exceeded its timeout:
+    the writer thread is stuck (dead filesystem, hung fsync, injected
+    fault). The previous committed checkpoint is still authoritative —
+    the in-flight snapshot never renamed."""
+
+
 class AsyncSnapshotWriter:
     """Single daemon writer thread with a one-deep job hand-off.
 
@@ -67,6 +74,10 @@ class AsyncSnapshotWriter:
         self.last_write_seconds: Optional[float] = None
         #: time.time() of the most recent successful commit.
         self.last_commit_time: Optional[float] = None
+        #: Human-readable label of the job currently in flight (the
+        #: checkpoint path) — named in the hung-writer error so the
+        #: operator knows which snapshot to inspect.
+        self.current_job: Optional[str] = None
 
     # -- writer thread --------------------------------------------------
 
@@ -94,43 +105,77 @@ class AsyncSnapshotWriter:
                 with self._mu:
                     self.pending -= 1
                     self.last_write_seconds = time.time() - t0
+                    self.current_job = None
                 self._idle.set()
 
     # -- submitting-thread API ------------------------------------------
 
-    def wait_for_slot(self) -> None:
+    def _await_idle(self, timeout: Optional[float]) -> None:
+        """Wait for the writer to go idle; on timeout, log the stuck job
+        and raise :class:`SnapshotWriterHung` instead of pinning the
+        caller (the fit-exit barrier) forever."""
+        if self._idle.wait(timeout):
+            return
+        with self._mu:
+            job = self.current_job
+        logger.error(
+            "checkpoint writer hung: job %r still in flight after "
+            "%.1fs; previous committed checkpoint remains authoritative",
+            job, timeout,
+        )
+        raise SnapshotWriterHung(
+            f"checkpoint writer did not finish {job!r} within "
+            f"{timeout:.1f}s"
+        )
+
+    def wait_for_slot(self, timeout: Optional[float] = None) -> None:
         """Block until no snapshot is in flight (counted in
         ``blocked_waits`` when it actually blocks) and surface any prior
         write error. Callers invoke this BEFORE materializing a new
         snapshot, so transient snapshot memory stays bounded to ONE
         table pair — snapshotting first and blocking in submit would
-        briefly hold two."""
+        briefly hold two. ``timeout`` raises
+        :class:`SnapshotWriterHung` instead of waiting forever."""
         if not self._idle.is_set():
             with self._mu:
                 self.blocked_waits += 1
-            self._idle.wait()
+            self._await_idle(timeout)
         self.raise_pending_error()
 
-    def submit(self, job: Callable[[], None]) -> None:
+    def submit(self, job: Callable[[], None],
+               label: Optional[str] = None,
+               timeout: Optional[float] = None) -> None:
         """Queue one snapshot job. Blocks while a previous snapshot is
         still in flight (the at-most-one guard; prefer
         :meth:`wait_for_slot` before building the snapshot); re-raises
         any error a previous job recorded — the failed save's state flip
         never ran, so the caller learns before trusting the checkpoint
-        chain."""
+        chain. ``label`` names the job in hung-writer diagnostics."""
         self._ensure_thread()
-        self.wait_for_slot()
+        self.wait_for_slot(timeout)
         with self._mu:
             self.pending += 1
+            self.current_job = label or getattr(job, "__name__", "job")
         self._idle.clear()
         self._jobs.put(job)
 
-    def wait(self, *, reraise: bool = True) -> None:
+    def wait(self, *, reraise: bool = True,
+             timeout: Optional[float] = None) -> None:
         """Barrier: return once no snapshot is in flight. ``reraise``
         surfaces a held write error (the fit-exit barrier wants it; the
         exception-path cleanup barrier must not mask the original
-        failure and passes False)."""
-        self._idle.wait()
+        failure and passes False). With ``timeout``, a writer thread
+        stuck past it logs the pending job and raises
+        :class:`SnapshotWriterHung` instead of hanging fit exit forever
+        (with ``reraise=False`` the hang is logged but NOT raised — the
+        cleanup barrier must not mask the original failure it is
+        unwinding)."""
+        try:
+            self._await_idle(timeout)
+        except SnapshotWriterHung:
+            if reraise:
+                raise
+            return
         if reraise:
             self.raise_pending_error()
 

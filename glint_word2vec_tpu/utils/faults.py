@@ -1,0 +1,186 @@
+"""Deterministic fault injection: the seam the whole fault-domain layer
+tests itself through.
+
+The reference inherits a fault model from Akka (supervision trees,
+delivery timeouts) but offers no way to *exercise* it — its integration
+tests only ever run the happy path. Here every recovery path (supervisor
+restart, checkpoint fallback, serving shed) is driven by the same named
+injection points in unit tests, the CI chaos job, and
+``scripts/chaos_drill.py``, so "we recover from a worker SIGKILL
+mid-epoch" is an asserted property, not a hope.
+
+Injection points are plain string names fired at the few places a fault
+domain boundary exists:
+
+  ``ckpt.pre_rename``    just before a snapshot directory's atomic commit
+  ``ckpt.post_rename``   just after it
+  ``worker.step``        once per dispatched training group (all fit loops)
+  ``producer.batch``     once per assembled batch group (producer thread)
+  ``serving.dispatch``   once per coalesced/simple serving device dispatch
+
+Arming is via the ``GLINT_FAULTS`` environment variable (parsed once at
+import) or :func:`arm` (tests). The spec grammar, ``;`` or ``,``
+separated::
+
+    point:action[@n]
+
+      action := exc          raise FaultInjected at the point
+              | kill         SIGKILL the current process (no cleanup,
+                             no atexit — the honest crash)
+              | hang[=secs]  sleep (default 3600s) — the hung-worker case
+              | delay[=secs] sleep briefly (default 0.05s) then continue
+      @n     := fire on the n-th hit of that point (1-based; default 1).
+                The point keeps counting afterwards but fires only once.
+
+    GLINT_FAULTS="worker.step:kill@120"          kill at the 120th group
+    GLINT_FAULTS="ckpt.pre_rename:exc"           fail the next commit
+    GLINT_FAULTS="producer.batch:hang@3;worker.step:delay=0.01"
+
+Unarmed cost is one module-global ``is None`` check per ``fire`` call —
+the points sit on per-group/per-dispatch paths, never per-pair.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+#: Every valid injection point, so a typo'd spec fails loudly at arm
+#: time instead of silently never firing.
+POINTS = (
+    "ckpt.pre_rename",
+    "ckpt.post_rename",
+    "worker.step",
+    "producer.batch",
+    "serving.dispatch",
+)
+
+_ACTIONS = ("exc", "kill", "hang", "delay")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``exc`` fault — deliberately a RuntimeError so
+    nothing in the stack catches it as an expected user error."""
+
+
+class _Spec:
+    __slots__ = ("point", "action", "arg", "at", "hits", "fired")
+
+    def __init__(self, point: str, action: str, arg: Optional[float],
+                 at: int):
+        self.point = point
+        self.action = action
+        self.arg = arg
+        self.at = at
+        self.hits = 0
+        self.fired = False
+
+
+#: point -> armed spec; None when nothing is armed (the zero-cost path).
+_ARMED: Optional[Dict[str, _Spec]] = None
+_MU = threading.Lock()
+
+
+def parse_spec(text: str) -> Dict[str, _Spec]:
+    """Parse a ``GLINT_FAULTS`` spec string; raises ``ValueError`` with
+    the offending clause on any grammar error."""
+    out: Dict[str, _Spec] = {}
+    for clause in text.replace(";", ",").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            point, _, rest = clause.partition(":")
+            point = point.strip()
+            if not rest:
+                raise ValueError("missing action")
+            action, _, at_s = rest.partition("@")
+            at = int(at_s) if at_s else 1
+            if at < 1:
+                raise ValueError("@n must be >= 1")
+            action, _, arg_s = action.partition("=")
+            action = action.strip()
+            arg = float(arg_s) if arg_s else None
+            if point not in POINTS:
+                raise ValueError(
+                    f"unknown injection point {point!r} "
+                    f"(valid: {', '.join(POINTS)})"
+                )
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"unknown action {action!r} "
+                    f"(valid: {', '.join(_ACTIONS)})"
+                )
+        except ValueError as e:
+            raise ValueError(f"bad GLINT_FAULTS clause {clause!r}: {e}")
+        out[point] = _Spec(point, action, arg, at)
+    return out
+
+
+def arm(text: Optional[str]) -> None:
+    """Arm from a spec string (None/empty disarms). Replaces any
+    previously armed set wholesale."""
+    global _ARMED
+    specs = parse_spec(text) if text else {}
+    with _MU:
+        _ARMED = specs or None
+    if specs:
+        logger.warning(
+            "fault injection ARMED: %s",
+            "; ".join(
+                f"{s.point}:{s.action}@{s.at}" for s in specs.values()
+            ),
+        )
+
+
+def disarm() -> None:
+    arm(None)
+
+
+def armed() -> bool:
+    return _ARMED is not None
+
+
+def fire(point: str) -> None:
+    """Hit one injection point. Free (one global read) when unarmed."""
+    if _ARMED is None:
+        return
+    with _MU:
+        spec = _ARMED.get(point) if _ARMED is not None else None
+        if spec is None:
+            return
+        spec.hits += 1
+        if spec.fired or spec.hits != spec.at:
+            return
+        spec.fired = True
+    logger.error(
+        "fault injection FIRING %s:%s at hit %d",
+        point, spec.action, spec.at,
+    )
+    if spec.action == "exc":
+        raise FaultInjected(f"injected fault at {point} (hit {spec.at})")
+    if spec.action == "kill":
+        # The honest crash: no cleanup, no Python teardown, no flushed
+        # buffers — exactly what a preempted/OOM-killed worker leaves.
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # pragma: no cover — never survives the signal
+    if spec.action == "hang":
+        time.sleep(spec.arg if spec.arg is not None else 3600.0)
+        return
+    if spec.action == "delay":
+        time.sleep(spec.arg if spec.arg is not None else 0.05)
+        return
+
+
+# Arm from the environment once at import: workers launched by the
+# supervisor (or the chaos drill) inherit their schedule with no code
+# changes anywhere.
+_env_spec = os.environ.get("GLINT_FAULTS")
+if _env_spec:
+    arm(_env_spec)

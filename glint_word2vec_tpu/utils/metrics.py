@@ -218,6 +218,13 @@ class ServingMetrics:
         self.warmup_compiles = 0
         self.cache_hits = 0
         self.cache_misses = 0
+        # Overload-protection counters (ISSUE 7): sheds by reason,
+        # deadline 504s, degraded-mode entries, in-flight peak.
+        self.shed_admission = 0
+        self.shed_degraded = 0
+        self.deadline_hits = 0
+        self.degraded_entered = 0
+        self.inflight_peak = 0
 
     #: Cap on distinct tracked endpoint paths: the key is the raw
     #: client-supplied request path, and without a bound a port scanner
@@ -252,6 +259,33 @@ class ServingMetrics:
             else:
                 self.cache_misses += 1
 
+    def record_shed(self, kind: str) -> None:
+        """One shed request: ``"admission"`` (past the in-flight
+        high-water mark, 429) or ``"degraded"`` (cache-only mode
+        shedding a device-needing request, 429)."""
+        with self._mu:
+            if kind == "admission":
+                self.shed_admission += 1
+            else:
+                self.shed_degraded += 1
+
+    def record_deadline(self) -> None:
+        """One request answered 504: its deadline passed before it
+        could reach the device."""
+        with self._mu:
+            self.deadline_hits += 1
+
+    def record_degraded_entered(self) -> None:
+        """One transition INTO degraded cache-only mode."""
+        with self._mu:
+            self.degraded_entered += 1
+
+    def record_inflight(self, n: int) -> None:
+        """Track the admitted in-flight high-water mark."""
+        with self._mu:
+            if n > self.inflight_peak:
+                self.inflight_peak = n
+
     def snapshot(self, total_compiles: int = 0,
                  checkpoint: Optional[dict] = None) -> dict:
         """``checkpoint`` is the engine's ``checkpoint_stats()`` dict
@@ -278,6 +312,13 @@ class ServingMetrics:
                 "synonym_cache": {
                     "hits": self.cache_hits,
                     "misses": self.cache_misses,
+                },
+                "overload": {
+                    "shed_admission_total": self.shed_admission,
+                    "shed_degraded_total": self.shed_degraded,
+                    "deadline_504_total": self.deadline_hits,
+                    "degraded_entered_total": self.degraded_entered,
+                    "inflight_peak": self.inflight_peak,
                 },
                 "compiles": {
                     "total": int(total_compiles),

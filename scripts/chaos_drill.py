@@ -1,0 +1,222 @@
+"""Chaos drill: a supervised fit under a scripted kill schedule.
+
+The executable proof of ISSUE 7's fault-domain layer: launch a training
+gang under ``parallel.supervisor.Supervisor``, arm a deterministic
+``GLINT_FAULTS`` kill on rank 0 (``worker.step:kill@G`` — SIGKILL at the
+G-th dispatch group, placed early in epoch 2 so at least one checkpoint
+has committed), and assert the whole story end to end:
+
+  * the supervisor detects the crash, tears the gang down (the surviving
+    rank is wedged in a collective — exactly the hang this layer exists
+    for), and relaunches exactly once;
+  * the relaunch resumes from the last committed checkpoint
+    (integrity-verified through ``utils.integrity.resolve_train_state``);
+  * the fit completes and the final model clears the same vienna/berlin
+    quality gates the CI smoke jobs use;
+  * restarts and recovery latency land in ``FAULT_BENCH.json`` (repo
+    root), comparable across PRs.
+
+Env: GLINT_CHAOS_WORKERS (gang size, default 2; 1 = supervised
+single-process fit), GLINT_CHAOS_ITERATIONS (default 6),
+GLINT_CHAOS_OUT (artifact path override). Exits nonzero if any gate
+fails.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from conftest import _make_tiny_corpus  # noqa: E402
+
+# Scrub the virtual-8-device XLA flag the test conftest just installed
+# (and anything the harness set): each WORKER must see exactly its own
+# real device count, or the gang's (workers, 1) mesh covers only rank
+# 0's devices and the cross-process collectives are malformed. The
+# supervisor itself never touches a device.
+os.environ.pop("XLA_FLAGS", None)
+
+OUT = os.environ.get(
+    "GLINT_CHAOS_OUT", os.path.join(ROOT, "FAULT_BENCH.json")
+)
+
+BATCH = 256
+SPC = 4
+WINDOW = 5
+MIN_COUNT = 5
+
+
+def _groups_per_epoch(sentences, workers: int) -> int:
+    """Dispatch groups per epoch for this corpus/config — the unit the
+    ``worker.step`` injection point counts in. Computed exactly the way
+    the fit loops size their epochs so the kill schedule is
+    deterministic: single-process runs the device-resident grid scan
+    (ceil(positions/B) steps), multi-process runs the host-batcher
+    lockstep schedule (ceil(max-shard-words/local-batch) steps)."""
+    from glint_word2vec_tpu.corpus.batching import (
+        chunk_sentences,
+        encode_sentences,
+    )
+    from glint_word2vec_tpu.corpus.vocab import build_vocab
+    from glint_word2vec_tpu.parallel.distributed import (
+        per_process_word_counts,
+    )
+
+    vocab = build_vocab(sentences, min_count=MIN_COUNT)
+    encoded = chunk_sentences(encode_sentences(sentences, vocab), 1000)
+    lens = np.array([s.size for s in encoded], dtype=np.int64)
+    if workers > 1:
+        counts = per_process_word_counts(lens, workers)
+        steps = max(1, math.ceil(int(counts.max()) / (BATCH // workers)))
+    else:
+        steps = max(1, math.ceil(int(lens.sum()) / BATCH))
+    return max(1, math.ceil(steps / SPC))
+
+
+def main() -> int:
+    workers = int(os.environ.get("GLINT_CHAOS_WORKERS", 2))
+    iterations = int(os.environ.get("GLINT_CHAOS_ITERATIONS", 6))
+    import tempfile
+
+    from glint_word2vec_tpu.parallel.supervisor import Supervisor
+
+    tmp = tempfile.mkdtemp(prefix="chaos_drill_")
+    corpus = os.path.join(tmp, "capitals.txt")
+    model_dir = os.path.join(tmp, "model")
+    ck_dir = os.path.join(tmp, "ck")
+    sentences = _make_tiny_corpus()
+    with open(corpus, "w") as f:
+        for s in sentences:
+            f.write(" ".join(s) + "\n")
+
+    gpe = _groups_per_epoch(sentences, workers)
+    # Early in epoch 2 for the multi-process gang (its epoch-boundary
+    # checkpoints are blocking + barriered, so ckpt-1 is committed
+    # before any epoch-2 group dispatches); one epoch later for the
+    # single-process async-checkpoint path, giving the background
+    # writer a whole epoch of margin to commit.
+    kill_at = (gpe if workers > 1 else 2 * gpe) + 2
+    fault = f"worker.step:kill@{kill_at}"
+
+    train_rest = [
+        "--corpus", corpus, "--output", model_dir,
+        "--vector-size", "48", "--window", str(WINDOW),
+        "--step-size", "0.025", "--batch-size", str(BATCH),
+        "--negatives", "5", "--min-count", str(MIN_COUNT),
+        "--iterations", str(iterations), "--seed", "1",
+        "--steps-per-call", str(SPC),
+        "--checkpoint-dir", ck_dir, "--checkpoint-every", "1",
+    ]
+    if workers > 1:
+        train_rest += [
+            "--num-partitions", str(workers), "--num-shards", "1",
+        ]
+
+    from glint_word2vec_tpu.parallel.supervisor import (
+        cli_train_build_argv,
+    )
+
+    build_argv = cli_train_build_argv(train_rest)
+
+    print(
+        f"chaos drill: {workers} worker(s), {gpe} groups/epoch, "
+        f"armed {fault!r} on rank 0 generation 0",
+        flush=True,
+    )
+    t0 = time.time()
+    report = Supervisor(
+        build_argv,
+        workers,
+        status_dir=os.path.join(tmp, "supervisor"),
+        checkpoint_dir=ck_dir,
+        # The kill schedule arms ONLY generation 0 of rank 0 — a
+        # re-armed relaunch would die at the same group forever.
+        rank_env_first_launch={0: {"GLINT_FAULTS": fault}},
+        heartbeat_stale_seconds=300.0,
+        startup_grace_seconds=600.0,
+        max_restarts=3,
+        backoff_base_seconds=0.5,
+        backoff_cap_seconds=5.0,
+    ).run()
+    wall = time.time() - t0
+
+    out = {
+        "metric": "chaos_drill",
+        "workers": workers,
+        "iterations": iterations,
+        "groups_per_epoch": gpe,
+        "fault": fault,
+        "wall_seconds": round(wall, 2),
+        "supervisor": report.to_dict(),
+    }
+
+    checks = {
+        "completed": report.completed,
+        "restarts_exactly_one": report.restarts == 1,
+        "resumed_from_committed_checkpoint": bool(
+            report.restart_records
+            and report.restart_records[0].resumed_from
+        ),
+    }
+    quality = {}
+    if report.completed:
+        from glint_word2vec_tpu.utils.platform import force_platform
+
+        force_platform()
+        from glint_word2vec_tpu import load_model
+
+        m = load_model(model_dir)
+        syns = m.find_synonyms("austria", 10)
+        ana = m.analogy(
+            positive=["vienna", "germany"], negative=["austria"], num=10
+        )
+        quality = {
+            "vienna_in_top10": "vienna" in [w for w, _ in syns],
+            "vienna_score": round(dict(syns).get("vienna", 0.0), 4),
+            "berlin_in_analogy_top10": "berlin" in [w for w, _ in ana],
+        }
+        checks["vienna_gate"] = bool(
+            quality["vienna_in_top10"] and quality["vienna_score"] > 0.5
+        )
+        checks["berlin_gate"] = quality["berlin_in_analogy_top10"]
+        state = json.load(open(os.path.join(ck_dir, "train_state.json")))
+        checks["all_epochs_committed"] = (
+            state["epochs_completed"] == iterations
+        )
+        out["final_train_state"] = {
+            "epochs_completed": state["epochs_completed"],
+            "ckpt": state["ckpt"],
+            "prev_ckpt": (state.get("prev") or {}).get("ckpt"),
+        }
+        import jax
+
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        if dev.platform != "tpu":
+            out["fallback"] = dev.platform
+    out["quality"] = quality
+    out["checks"] = checks
+
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    if not all(checks.values()):
+        print("chaos drill FAILED gates:", [
+            k for k, v in checks.items() if not v
+        ], file=sys.stderr)
+        return 1
+    print("chaos drill ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

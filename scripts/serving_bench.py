@@ -90,7 +90,7 @@ def _worker_main(argv) -> None:
         ).encode("latin-1") + b
         for b in bodies
     ]
-    lats, errors = [], 0
+    lats, errors, status_counts = [], 0, {}
     sock = socket.create_connection((host, port), timeout=30)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     buf = bytearray()
@@ -104,6 +104,13 @@ def _worker_main(argv) -> None:
         try:
             sock.sendall(req)
             status = _read_response(sock, buf)
+            if record:
+                # Per-status accounting for the overload cell: sheds
+                # (429) and deadline hits (504) are EXPECTED there and
+                # must be distinguishable from real failures.
+                status_counts[str(status)] = (
+                    status_counts.get(str(status), 0) + 1
+                )
             if status != 200:
                 errors += 1
                 return
@@ -138,7 +145,11 @@ def _worker_main(argv) -> None:
     finally:
         sock.close()
     with open(out_file, "w") as f:
-        json.dump({"lats": lats, "errors": errors}, f)
+        json.dump(
+            {"lats": lats, "errors": errors,
+             "status_counts": status_counts},
+            f,
+        )
 
 
 if len(sys.argv) > 1 and sys.argv[1] == "--worker":
@@ -244,19 +255,25 @@ def bench_endpoint(server, name, path, payload_file, concurrency, seconds,
     for p in procs:
         p.wait(timeout=max(1, join_deadline - time.time()))
     compiles_after = _get(server.host, server.port, "/healthz")["compiles"]
-    lats, errors = [], 0
+    lats, errors, status_counts = [], 0, {}
     for f in out_files:
         with open(f) as fh:
             d = json.load(fh)
         lats.extend(d["lats"])
         errors += d["errors"]
+        for k, v in d.get("status_counts", {}).items():
+            status_counts[k] = status_counts.get(k, 0) + v
     if not lats:
-        return {"error": f"no successful requests ({errors} errors)"}
+        return {
+            "error": f"no successful requests ({errors} errors)",
+            "status_counts": status_counts,
+        }
     xs = np.asarray(sorted(lats))
     return {
         "concurrency": concurrency,
         "requests": len(lats),
         "errors": errors,
+        "status_counts": status_counts,
         "qps": round(len(lats) / seconds, 1),
         "p50_ms": round(float(np.quantile(xs, 0.50)) * 1e3, 2),
         "p95_ms": round(float(np.quantile(xs, 0.95)) * 1e3, 2),
@@ -373,6 +390,57 @@ def main():
                 )
             out["endpoints"]["/" + name] = rows
     out["metrics_snapshot"] = _get(server.host, server.port, "/metrics")
+    server.stop()
+
+    # Overload cell (ISSUE 7): a 4x-oversubscribed closed loop against a
+    # deliberately tiny admission bound, so the shedding machinery — not
+    # the queue — absorbs the spike. The contract: every response is
+    # 200 (admitted), 429 (shed with Retry-After), or 504 (deadline);
+    # NOTHING else in the 5xx range, and the p99 of ADMITTED requests
+    # stays bounded by the deadline budget rather than growing with the
+    # queue as it would unprotected.
+    over_inflight = int(os.environ.get("GLINT_SERVE_MAX_INFLIGHT", 4))
+    over_deadline = float(os.environ.get("GLINT_SERVE_DEADLINE", 1.0))
+    over_clients = 4 * over_inflight
+    over_server = ModelServer(
+        model, port=0, max_batch=16,
+        max_inflight=over_inflight, request_deadline=over_deadline,
+        degraded_after=5.0,
+    )
+    over_server.start_background()
+    with tempfile.TemporaryDirectory(prefix="serving_over_") as tmp:
+        pf = os.path.join(tmp, "overload.jsonl")
+        with open(pf, "w") as f:
+            # num=13: disjoint from every cold/hot cell's (word, num)
+            # keys, so the result cache cannot serve this cell.
+            f.write("\n".join(
+                json.dumps({"word": w, "num": 13}) for w in wide
+            ))
+        cell = bench_endpoint(
+            over_server, "overload", "/synonyms", pf, over_clients,
+            seconds, tmp, stride=max(1, len(wide) // 16), base=3000,
+        )
+    over_metrics = _get(over_server.host, over_server.port, "/metrics")
+    over_server.stop()
+    sc = cell.get("status_counts", {})
+    total_resp = sum(sc.values())
+    n_5xx_other = sum(
+        v for k, v in sc.items() if k.startswith("5") and k != "504"
+    )
+    out["overload"] = {
+        "max_inflight": over_inflight,
+        "request_deadline_seconds": over_deadline,
+        "clients": over_clients,
+        "cell": cell,
+        "shed_429": sc.get("429", 0),
+        "deadline_504": sc.get("504", 0),
+        "admitted_200": sc.get("200", 0),
+        "shed_rate": (
+            round(sc.get("429", 0) / total_resp, 4) if total_resp else None
+        ),
+        "p99_of_admitted_ms": cell.get("p99_ms"),
+        "server_counters": over_metrics.get("overload", {}),
+    }
 
     # The ISSUE 2 acceptance contract, recorded in the artifact itself.
     cells = [
@@ -401,14 +469,27 @@ def main():
         "device_dispatch_ratio_16v1": out["device_dispatch_ms"][
             "ratio_16v1"
         ],
+        # ISSUE 7 overload gates: under 4x oversubscription the only
+        # 5xx the server may emit is the deadline 504, and the p99 of
+        # requests it actually ADMITTED stays inside the deadline
+        # budget (deadline + 1s dispatch headroom on this CPU box)
+        # instead of growing with the queue.
+        "overload_no_unexpected_5xx": n_5xx_other == 0,
+        "overload_shed_rate": out["overload"]["shed_rate"],
+        "overload_p99_admitted_bounded": (
+            cell.get("p99_ms") is not None
+            and cell["p99_ms"] <= (over_deadline + 1.0) * 1e3
+        ),
     }
 
-    server.stop()
     model.stop()
     with open(OUT, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
     if not out["checks"]["zero_compiles_in_measured_windows"]:
+        sys.exit(1)
+    if not (out["checks"]["overload_no_unexpected_5xx"]
+            and out["checks"]["overload_p99_admitted_bounded"]):
         sys.exit(1)
 
 

@@ -1,0 +1,164 @@
+"""Fault-injection seam tests (ISSUE 7, utils/faults.py): spec grammar,
+deterministic firing, zero-cost disarm, and propagation through the
+producer pipeline — plus the hung-checkpoint-writer timeout satellite."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.utils import faults
+from glint_word2vec_tpu.utils.async_ckpt import (
+    AsyncSnapshotWriter,
+    SnapshotWriterHung,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def test_spec_grammar():
+    specs = faults.parse_spec(
+        "worker.step:kill@120; ckpt.pre_rename:exc, producer.batch:hang=0.1@3"
+    )
+    assert set(specs) == {"worker.step", "ckpt.pre_rename", "producer.batch"}
+    assert specs["worker.step"].action == "kill"
+    assert specs["worker.step"].at == 120
+    assert specs["ckpt.pre_rename"].at == 1
+    assert specs["producer.batch"].arg == 0.1
+    assert specs["producer.batch"].at == 3
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuch.point:exc",          # unknown point
+    "worker.step:explode",       # unknown action
+    "worker.step",               # missing action
+    "worker.step:exc@0",         # @n must be >= 1
+    "worker.step:exc@x",         # non-integer @n
+])
+def test_bad_specs_raise(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_unarmed_fire_is_noop_and_cheap():
+    assert not faults.armed()
+    for _ in range(1000):
+        faults.fire("worker.step")  # must never raise
+
+
+def test_exc_fires_exactly_once_at_nth_hit():
+    faults.arm("serving.dispatch:exc@3")
+    faults.fire("serving.dispatch")
+    faults.fire("serving.dispatch")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("serving.dispatch")
+    # Fires ONCE: the 4th and later hits pass (a restarted consumer of
+    # the same armed process must not die forever).
+    faults.fire("serving.dispatch")
+    faults.fire("serving.dispatch")
+
+
+def test_only_named_point_fires():
+    faults.arm("ckpt.pre_rename:exc")
+    faults.fire("worker.step")
+    faults.fire("serving.dispatch")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("ckpt.pre_rename")
+
+
+def test_delay_action_sleeps_then_continues():
+    faults.arm("worker.step:delay=0.05")
+    t0 = time.monotonic()
+    faults.fire("worker.step")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_producer_batch_exc_propagates_through_prefetch():
+    # An injected producer fault must surface on the consumer thread —
+    # the prefetch pipeline's error contract, exercised via the real
+    # group_batches producer the host fit loop uses.
+    from glint_word2vec_tpu.corpus.batching import Batch, group_batches
+    from glint_word2vec_tpu.utils.prefetch import prefetch
+
+    def batches():
+        B, C = 4, 2
+        while True:
+            yield Batch(
+                centers=np.zeros(B, np.int32),
+                contexts=np.zeros((B, C), np.int32),
+                mask=np.ones((B, C), np.float32),
+                words_done=B,
+            )
+
+    faults.arm("producer.batch:exc@2")
+    it = prefetch(group_batches(batches(), 2), depth=2)
+    next(it)  # group 1 produced before the armed hit
+    with pytest.raises(faults.FaultInjected):
+        for _ in range(4):
+            next(it)
+
+
+# ----------------------------------------------------------------------
+# Hung-writer timeout (satellite: async_ckpt wait accepts a timeout)
+# ----------------------------------------------------------------------
+
+
+def test_writer_wait_timeout_raises_and_names_job():
+    w = AsyncSnapshotWriter()
+    release = threading.Event()
+    w.submit(lambda: release.wait(30), label="/ck/ckpt-7")
+    try:
+        with pytest.raises(SnapshotWriterHung) as e:
+            w.wait(timeout=0.2)
+        assert "/ck/ckpt-7" in str(e.value)
+        # wait_for_slot honors the timeout too (the submit-side guard).
+        with pytest.raises(SnapshotWriterHung):
+            w.wait_for_slot(timeout=0.2)
+    finally:
+        release.set()
+    w.wait(timeout=30)  # drains cleanly once released
+    assert w.commits == 1
+
+
+def test_writer_wait_no_reraise_swallows_hang():
+    # The exception-path cleanup barrier must not mask the original
+    # failure with a SnapshotWriterHung of its own.
+    w = AsyncSnapshotWriter()
+    release = threading.Event()
+    w.submit(lambda: release.wait(30))
+    try:
+        w.wait(reraise=False, timeout=0.2)  # must return, not raise
+    finally:
+        release.set()
+    w.wait(timeout=30)
+
+
+def test_engine_wait_pending_saves_timeout(tmp_path, monkeypatch):
+    from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    eng = EmbeddingEngine(
+        make_mesh(1, 1), 32, 8, np.arange(32, 0, -1), seed=0
+    )
+    release = threading.Event()
+    orig = EmbeddingEngine._write_snapshot
+
+    def slow_write(self, path, files, meta, **kw):
+        release.wait(30)
+        return orig(self, path, files, meta, **kw)
+
+    monkeypatch.setattr(EmbeddingEngine, "_write_snapshot", slow_write)
+    assert eng.save_async(str(tmp_path / "ck"))
+    try:
+        with pytest.raises(SnapshotWriterHung):
+            eng.wait_pending_saves(timeout=0.2)
+    finally:
+        release.set()
+    eng.wait_pending_saves(timeout=30)
+    eng.destroy()

@@ -205,7 +205,8 @@ def test_obsrun_init_failure_uninstalls_recorder(tmp_path):
         holder.close()
 
 
-def test_heartbeat_healthz_500_on_diverged():
+def test_heartbeat_healthz_503_on_diverged():
+    # 503, not 500 (ISSUE 7): fleet probes work off the status code.
     status = TrainingStatus()
     status.update(state="diverged")
     srv = HeartbeatServer(status, port=0)
@@ -213,10 +214,41 @@ def test_heartbeat_healthz_500_on_diverged():
     try:
         with pytest.raises(urllib.error.HTTPError) as e:
             _get(srv.host, srv.port, "/healthz")
-        assert e.value.code == 500
+        assert e.value.code == 503
         assert json.loads(e.value.read())["status"] == "diverged"
     finally:
         srv.stop()
+
+
+def test_heartbeat_healthz_503_on_mark_unhealthy():
+    status = TrainingStatus()
+    status.update(state="running")
+    status.mark_unhealthy("supervisor: peer worker died")
+    srv = HeartbeatServer(status, port=0)
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.host, srv.port, "/healthz")
+        assert e.value.code == 503
+        body = json.loads(e.value.read())
+        assert body["status"] == "unhealthy"
+        assert "peer worker" in body["unhealthy_reason"]
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_supervisor_generation_handshake(monkeypatch):
+    monkeypatch.setenv("GLINT_SUPERVISOR_GEN", "3")
+    status = TrainingStatus()
+    snap = status.snapshot(include_devices=False)
+    assert snap["supervisor_generation"] == 3
+    monkeypatch.delenv("GLINT_SUPERVISOR_GEN")
+    assert (
+        TrainingStatus().snapshot(include_devices=False)[
+            "supervisor_generation"
+        ]
+        is None
+    )
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,221 @@
+"""Serving overload-protection tests (ISSUE 7): bounded admission with
+429 + Retry-After, per-request deadlines answered 504 without occupying
+a dispatch slot, degraded cache-only mode while the device lock is
+wedged, the overload counters on both /metrics renderers, and the
+serving.dispatch fault seam."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from glint_word2vec_tpu import Word2Vec
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.serving import ModelServer
+from glint_word2vec_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def model(tiny_corpus):
+    m = Word2Vec(
+        mesh=make_mesh(1, 2), vector_size=16, min_count=5,
+        batch_size=128, seed=2, num_iterations=2,
+    ).fit(tiny_corpus)
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def make_server(model):
+    servers = []
+
+    def _make(**kw):
+        kw.setdefault("warmup", False)
+        server = ModelServer(model, port=0, **kw)
+        server.start_background()
+        servers.append(server)
+        return server
+
+    yield _make
+    for s in servers:
+        s.stop()
+
+
+def _post(server, path, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def _hold_lock(server, seconds):
+    """Occupy the device lock from a background thread — the wedged /
+    slow dispatch the deadline and degraded paths defend against."""
+    acquired = threading.Event()
+
+    def hold():
+        server._lock.acquire()
+        acquired.set()
+        time.sleep(seconds)
+        server._lock.release()
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert acquired.wait(5)
+    return t
+
+
+def test_admission_shed_429_with_retry_after(make_server):
+    server = make_server(max_inflight=1, request_deadline=5.0,
+                         degraded_after=None)
+    holder = _hold_lock(server, 1.0)
+    # First request is admitted and parks on the device lock; the
+    # second exceeds the high-water mark and must shed immediately.
+    results = {}
+
+    def admitted():
+        results["a"] = _post(
+            server, "/synonyms", {"word": "austria", "num": 5}
+        )
+
+    t = threading.Thread(target=admitted, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while server._inflight < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms", {"word": "vienna", "num": 5})
+    assert e.value.code == 429
+    assert e.value.headers.get("Retry-After") == "1"
+    assert time.time() - t0 < 0.5  # shed NOW, not after queueing
+    t.join(timeout=30)
+    holder.join(timeout=30)
+    assert len(results["a"]) == 5  # the admitted one completed fine
+    snap = _get(server, "/metrics")
+    assert snap["overload"]["shed_admission_total"] >= 1
+    assert snap["overload"]["inflight_peak"] >= 1
+
+
+def test_deadline_answered_504_without_dispatch_slot(make_server):
+    server = make_server(max_inflight=8, request_deadline=0.3,
+                         degraded_after=None)
+    holder = _hold_lock(server, 1.5)
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms", {"word": "austria", "num": 5})
+    assert e.value.code == 504
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/analogy",
+              {"positive": ["vienna"], "negative": [], "num": 3})
+    assert e.value.code == 504
+    # Both answered within ~the deadline, not the lock-hold time.
+    assert time.time() - t0 < 1.4
+    holder.join(timeout=30)
+    # Abandoned waiters remove themselves from the pending list — it
+    # must not grow while the device is wedged (no leader to drain it).
+    assert server._coalescer._pending == []
+    snap = _get(server, "/metrics")
+    assert snap["overload"]["deadline_504_total"] == 2
+    # The device was never touched for them: once the lock frees, a
+    # fresh request succeeds normally.
+    assert len(_post(server, "/synonyms", {"word": "austria", "num": 5})) == 5
+
+
+def test_degraded_cache_only_serves_hits_sheds_misses(make_server):
+    server = make_server(max_inflight=8, request_deadline=10.0,
+                         degraded_after=0.2, cache_size=1024)
+    # Prime the result cache while the device is free.
+    hot = _post(server, "/synonyms", {"word": "austria", "num": 5})
+    holder = _hold_lock(server, 2.0)
+    time.sleep(0.4)  # past degraded_after
+    assert _get(server, "/healthz")["status"] == "degraded"
+    # Cache hit: served with zero device work, identical result.
+    assert _post(
+        server, "/synonyms", {"word": "austria", "num": 5}
+    ) == hot
+    # Cache miss: shed 429 (NOT 5xx — the client should back off).
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/synonyms", {"word": "vienna", "num": 5})
+    assert e.value.code == 429
+    assert e.value.headers.get("Retry-After") == "1"
+    # Endpoints with no cache shed too.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/transform", {"sentences": [["austria"]]})
+    assert e.value.code == 429
+    holder.join(timeout=30)
+    # Lock freed: mode exits automatically.
+    assert _get(server, "/healthz")["status"] == "ok"
+    assert len(_post(server, "/synonyms", {"word": "vienna", "num": 5})) == 5
+    snap = _get(server, "/metrics")
+    assert snap["overload"]["shed_degraded_total"] >= 2
+    assert snap["overload"]["degraded_entered_total"] >= 1
+
+
+def test_overload_counters_render_in_prometheus(make_server):
+    from glint_word2vec_tpu.obs.prometheus import lint_prometheus_text
+
+    server = make_server()
+    _post(server, "/synonyms", {"word": "austria", "num": 5})
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}"
+        "/metrics?format=prometheus", timeout=30
+    ) as r:
+        text = r.read().decode()
+    lint_prometheus_text(text)
+    for name in (
+        'glint_serving_shed_total{reason="admission"}',
+        'glint_serving_shed_total{reason="degraded"}',
+        "glint_serving_deadline_hits_total",
+        "glint_serving_degraded_entered_total",
+        "glint_serving_inflight_peak",
+    ):
+        assert name in text, name
+
+
+def test_healthz_reports_overload_config(make_server):
+    server = make_server(max_inflight=7, request_deadline=2.5,
+                         degraded_after=1.25)
+    h = _get(server, "/healthz")
+    assert h["max_inflight"] == 7
+    assert h["request_deadline_seconds"] == 2.5
+    assert h["degraded_after_seconds"] == 1.25
+
+
+def test_zero_disables_each_protection(make_server):
+    server = make_server(max_inflight=0, request_deadline=0,
+                         degraded_after=0)
+    assert server.max_inflight == 0
+    assert server.request_deadline is None
+    assert server.degraded_after is None
+    # With everything off a request during a short lock hold just waits.
+    holder = _hold_lock(server, 0.3)
+    assert len(_post(server, "/synonyms", {"word": "austria", "num": 5})) == 5
+    holder.join(timeout=30)
+
+
+def test_dispatch_fault_fails_one_request_server_survives(make_server):
+    server = make_server()
+    faults.arm("serving.dispatch:exc@1")
+    try:
+        with pytest.raises(Exception):
+            # The injected dispatch failure drops this connection /
+            # errors this request — never the whole server.
+            _post(server, "/synonyms", {"word": "austria", "num": 5})
+    finally:
+        faults.disarm()
+    assert len(_post(server, "/synonyms", {"word": "austria", "num": 5})) == 5
+    assert _get(server, "/healthz")["status"] == "ok"

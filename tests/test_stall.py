@@ -166,9 +166,9 @@ def test_second_async_save_blocks_and_is_counted(tmp_path, monkeypatch):
     release = threading.Event()
     orig = EmbeddingEngine._write_snapshot
 
-    def slow_write(self, path, files, meta):
+    def slow_write(self, path, files, meta, **kw):
         release.wait(timeout=30)
-        return orig(self, path, files, meta)
+        return orig(self, path, files, meta, **kw)
 
     monkeypatch.setattr(EmbeddingEngine, "_write_snapshot", slow_write)
     eng.save_async(str(tmp_path / "ck-1"))

@@ -1,0 +1,186 @@
+"""Elastic-supervisor tests (ISSUE 7, parallel/supervisor.py) with
+jax-free stub workers: crash detection via waitpid, hang detection via
+stale status-file heartbeats, whole-gang teardown, generation-gated
+relaunch env, backoff/budget, and the report the chaos drill records."""
+
+import json
+import os
+import sys
+import time
+
+from glint_word2vec_tpu.parallel.supervisor import Supervisor
+
+# Stub worker: writes generation-stamped heartbeats, then follows the
+# behavior its env/generation selects. argv: <status_file> <behavior>
+_STUB = r"""
+import json, os, sys, time
+
+status_file, behavior = sys.argv[1], sys.argv[2]
+gen = int(os.environ.get("GLINT_SUPERVISOR_GEN", "-1"))
+
+
+def beat(state="running"):
+    tmp = status_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"state": state, "supervisor_generation": gen}, f)
+    os.replace(tmp, status_file)
+
+
+beat()
+if behavior == "ok":
+    for _ in range(3):
+        time.sleep(0.05)
+        beat()
+    beat("done")
+    sys.exit(0)
+if behavior == "crash-env":
+    # Crashes only when the first-launch-only env var is present.
+    if os.environ.get("GLINT_TEST_CRASH") == "1":
+        sys.exit(3)
+    time.sleep(0.1)
+    beat("done")
+    sys.exit(0)
+if behavior == "crash-always":
+    time.sleep(0.05)
+    sys.exit(3)
+if behavior == "hang-gen0":
+    if gen == 0:
+        time.sleep(120)  # heartbeat never refreshes -> stale
+    time.sleep(0.1)
+    beat("done")
+    sys.exit(0)
+if behavior == "wedge-on-peer":
+    # Rank 0 crashes in gen 0; rank 1 "wedges" (keeps heartbeating but
+    # never exits) — only the gang teardown can end it.
+    rank = int(sys.argv[3])
+    if gen == 0 and rank == 0:
+        sys.exit(3)
+    if gen == 0:
+        for _ in range(2400):
+            time.sleep(0.05)
+            beat()
+        sys.exit(0)
+    time.sleep(0.1)
+    beat("done")
+    sys.exit(0)
+sys.exit(99)
+"""
+
+
+def _sup(tmp_path, behavior, workers=1, **kw):
+    stub = tmp_path / "stub.py"
+    stub.write_text(_STUB)
+
+    def build_argv(rank, n, port, status_file, generation):
+        return [
+            sys.executable, str(stub), status_file, behavior, str(rank),
+        ]
+
+    defaults = dict(
+        status_dir=str(tmp_path / "sup"),
+        poll_interval=0.05,
+        max_restarts=2,
+        backoff_base_seconds=0.05,
+        backoff_cap_seconds=0.2,
+        kill_grace_seconds=1.0,
+        heartbeat_stale_seconds=1.0,
+        startup_grace_seconds=10.0,
+    )
+    defaults.update(kw)
+    return Supervisor(build_argv, workers, **defaults)
+
+
+def test_clean_completion_no_restarts(tmp_path):
+    report = _sup(tmp_path, "ok", workers=2).run()
+    assert report.completed
+    assert report.restarts == 0
+    assert report.generations == 1
+
+
+def test_crash_detected_restarted_once_env_not_rearmed(tmp_path):
+    # The first-launch-only env (the chaos drill's GLINT_FAULTS seam)
+    # crashes generation 0; generation 1 runs WITHOUT it and completes.
+    report = _sup(
+        tmp_path, "crash-env",
+        rank_env_first_launch={0: {"GLINT_TEST_CRASH": "1"}},
+    ).run()
+    assert report.completed
+    assert report.restarts == 1
+    rec = report.restart_records[0]
+    assert "exited with code 3" in rec.reason
+    assert rec.detect_to_relaunch_seconds >= rec.backoff_seconds
+    d = report.to_dict()
+    assert d["restart_records"][0]["reason"] == rec.reason
+
+
+def test_gang_teardown_kills_wedged_survivor(tmp_path):
+    # Rank 0 dies; rank 1 heartbeats forever (the stuck-collective
+    # analogue). The supervisor must kill it, relaunch BOTH, complete.
+    t0 = time.time()
+    report = _sup(tmp_path, "wedge-on-peer", workers=2).run()
+    assert report.completed
+    assert report.restarts == 1
+    assert time.time() - t0 < 60  # the wedged worker did not pin us
+
+
+def test_restart_budget_exhausted_gives_up(tmp_path):
+    report = _sup(tmp_path, "crash-always", max_restarts=2).run()
+    assert not report.completed
+    assert report.restarts == 2
+    assert "budget" in report.gave_up_reason
+
+
+def test_hang_detected_via_stale_heartbeat(tmp_path):
+    report = _sup(
+        tmp_path, "hang-gen0", heartbeat_stale_seconds=0.5,
+    ).run()
+    assert report.completed
+    assert report.restarts == 1
+    assert "stale" in report.restart_records[0].reason
+
+
+def test_stale_pre_restart_status_file_not_trusted(tmp_path):
+    # A status file stamped with an older generation must read as
+    # "no heartbeat yet", not as a live (or stale) current one.
+    sup = _sup(tmp_path, "ok")
+    os.makedirs(sup.status_dir, exist_ok=True)
+    with open(sup._status_file(0), "w") as f:
+        json.dump({"state": "running", "supervisor_generation": 0}, f)
+    assert sup._read_status(0, generation=1) is None
+    assert sup._read_status(0, generation=0) is not None
+
+
+def test_cli_supervise_validates_arguments(capsys):
+    # jax-free: the supervise branch returns before any device setup.
+    from glint_word2vec_tpu import cli
+
+    assert cli.main(["supervise", "--workers", "1"]) == 1
+    assert "expects the train command" in capsys.readouterr().err
+    assert cli.main(["supervise", "train", "--corpus", "x"]) == 1
+    assert "--checkpoint-dir" in capsys.readouterr().err
+
+
+def test_cli_argv_value_forms():
+    from glint_word2vec_tpu.cli import _argv_value
+
+    argv = ["--corpus", "c.txt", "--checkpoint-dir", "a",
+            "--checkpoint-dir=b"]
+    assert _argv_value(argv, "--checkpoint-dir") == "b"  # last wins
+    assert _argv_value(argv, "--corpus") == "c.txt"
+    assert _argv_value(argv, "--output") is None
+
+
+def test_gave_up_on_unverifiable_checkpoint(tmp_path):
+    # A crash with a train_state.json pointing only at corrupt
+    # snapshots must GIVE UP (never silently retrain from scratch).
+    ck = tmp_path / "ck"
+    os.makedirs(ck / "ckpt-1")
+    with open(ck / "train_state.json", "w") as f:
+        json.dump({"epochs_completed": 1, "step": 1, "words_done": 1,
+                   "ckpt": "ckpt-1"}, f)
+    report = _sup(
+        tmp_path, "crash-always", checkpoint_dir=str(ck), max_restarts=3,
+    ).run()
+    assert not report.completed
+    assert report.restarts == 0
+    assert "no verifiable checkpoint" in report.gave_up_reason
