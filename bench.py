@@ -156,15 +156,19 @@ def _flops_per_step(mode: str, cfg, mask_density: float) -> float:
 
 
 def _bench_obs_overhead(jax, np):
-    """ISSUE 3 overhead guard: a fit with the full observability stack
-    enabled (event ring + JSONL sink + Chrome-trace export + heartbeat
-    server + status file + warn canary) must stay within 3% words/sec of
-    the same fit with observability off. Runs the real production fit
+    """ISSUE 3 overhead guard, re-run for ISSUE 8 with the step-time
+    attribution ledger in the stack: a fit with the full observability
+    suite enabled (event ring + JSONL sink + Chrome-trace export +
+    heartbeat server + status file + warn canary + attribution ledger
+    with STEPTIME.json dump) must stay within 3% words/sec of the same
+    fit with observability off (where the ledger path is the one
+    module-global NULL_SPAN read). Runs the real production fit
     (device-resident corpus path) three times — warm-up (compiles,
-    discarded), baseline, instrumented — and reports both throughputs
-    plus the overhead fraction. Mode name: ``obs_overhead`` in
-    BENCH_MODES (not in the default set; words/sec here is from a small
-    fit, not comparable to the engine-loop modes)."""
+    discarded), baseline, instrumented — and reports both throughputs,
+    the overhead fraction, and the ledger's phase breakdown. Mode name:
+    ``obs_overhead`` in BENCH_MODES (not in the default set; words/sec
+    here is from a small fit, not comparable to the engine-loop
+    modes)."""
     import tempfile
 
     from glint_word2vec_tpu.models.word2vec import Word2Vec
@@ -199,14 +203,24 @@ def _bench_obs_overhead(jax, np):
             status_port=0,
             status_file=os.path.join(td, "status.json"),
             canary="warn",
+            steptime_path=os.path.join(td, "STEPTIME.json"),
         )
         instrumented, _ = run(obs)
+        import json as _json
+
+        with open(os.path.join(td, "STEPTIME.json")) as f:
+            steptime = _json.load(f)
     return {
         "words_per_sec": instrumented,
         "words_per_sec_baseline": base,
         "overhead_frac": round(1.0 - instrumented / base, 4),
         "corpus_words": n_words,
         "pipeline": pipeline,
+        "steptime_wall_seconds": steptime["wall_seconds"],
+        "steptime_phases": {
+            p: info["seconds"]
+            for p, info in steptime["phases"].items()
+        },
         "inputs": "fit_list",
     }
 

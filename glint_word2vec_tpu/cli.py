@@ -90,6 +90,13 @@ def _add_train(sub):
     obs.add_argument("--event-capacity", type=int, default=65536,
                      help="in-memory event ring bound; overflow is "
                           "counted, never unbounded (default 65536)")
+    obs.add_argument("--steptime-out", default=None,
+                     help="write the per-run step-time attribution "
+                          "ledger (STEPTIME.json) here at fit end: "
+                          "fit-thread wall seconds by phase (dispatch, "
+                          "readback_harvest, producer_wait, compact, "
+                          "checkpoint, other) + per-phase span-duration "
+                          "quantiles")
     obs.add_argument("--chrome-trace", default=None,
                      help="write the event log as chrome://tracing / "
                           "Perfetto JSON at run end (merge with device "
@@ -222,7 +229,33 @@ def _add_query(sub):
                         "(default: <checkpoint-dir>/supervisor)")
     p.add_argument("--report-out", default=None,
                    help="write the supervisor report JSON here too "
-                        "(it always prints to stdout)")
+                        "(it always prints to stdout): restarts, "
+                        "per-restart detect->relaunch/heartbeat "
+                        "latency, and the postmortem bundle paths the "
+                        "flight recorder collected")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve the MERGED gang observability endpoint "
+                        "on this port (0 = ephemeral): one /metrics "
+                        "(JSON; ?format=prometheus for scrape-ready "
+                        "text) + /healthz for the whole gang — summed "
+                        "counters, per-rank words/sec, the rank_skew "
+                        "straggler gauge, merged step-time ledger — "
+                        "generation-stamped so pre-restart scrapes are "
+                        "never mixed in")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   help="merged-endpoint bind address")
+    p.add_argument("--join-serving", action="append", default=[],
+                   metavar="URL",
+                   help="serving-replica JSON /metrics URL to join "
+                        "into the merged exposition (repeatable; "
+                        "scraped per request, replica failures "
+                        "reported, never fatal)")
+    p.add_argument("--rank0-env", action="append", default=[],
+                   metavar="KEY=VAL",
+                   help="env var applied to rank 0's FIRST launch only "
+                        "(generation 0, repeatable) — the chaos-drill "
+                        "seam for arming a GLINT_FAULTS schedule "
+                        "without re-killing every relaunch")
     p.add_argument(
         "train_args", nargs=argparse.REMAINDER,
         help="the train command to supervise: everything after the "
@@ -309,6 +342,17 @@ def _run_supervise(args) -> int:
         checkpoint_dir, "supervisor"
     )
 
+    rank0_env = {}
+    for kv in args.rank0_env:
+        if "=" not in kv:
+            print(
+                f"error: --rank0-env expects KEY=VAL, got {kv!r}",
+                file=sys.stderr,
+            )
+            return 1
+        k, v = kv.split("=", 1)
+        rank0_env[k] = v
+
     from glint_word2vec_tpu.parallel.supervisor import (
         Supervisor,
         cli_train_build_argv,
@@ -319,6 +363,7 @@ def _run_supervise(args) -> int:
         args.workers,
         status_dir=sup_dir,
         checkpoint_dir=checkpoint_dir,
+        rank_env_first_launch={0: rank0_env} if rank0_env else None,
         heartbeat_stale_seconds=(
             args.heartbeat_stale if args.heartbeat_stale > 0 else None
         ),
@@ -326,6 +371,9 @@ def _run_supervise(args) -> int:
         max_restarts=args.max_restarts,
         backoff_base_seconds=args.backoff_base,
         backoff_cap_seconds=args.backoff_cap,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
+        serving_urls=args.join_serving,
     ).run()
     out = report.to_dict()
     print(json.dumps(out))
@@ -379,7 +427,7 @@ def _run(args) -> int:
         obs = None
         if (args.status_port is not None or args.status_file
                 or args.event_log or args.chrome_trace
-                or args.canary != "off"):
+                or args.steptime_out or args.canary != "off"):
             from glint_word2vec_tpu.obs import ObsConfig
 
             obs = ObsConfig(
@@ -393,6 +441,7 @@ def _run(args) -> int:
                 canary_window=args.canary_window,
                 canary_factor=args.canary_factor,
                 canary_check_every=args.canary_check_every,
+                steptime_path=args.steptime_out,
             )
         if args.fasttext:
             w2v = FastTextWord2Vec(

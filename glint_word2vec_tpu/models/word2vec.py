@@ -935,6 +935,12 @@ class Word2Vec:
         model.training_metrics = {
             **metrics.summary(), "pipeline": "device_corpus",
         }
+        # Step-time attribution (ISSUE 8): where the fit thread's wall
+        # went, by phase — the breakdown that replaces eyeballing the
+        # single device_stall_seconds proxy. None when obs is off.
+        steptime = obs_run.steptime_totals()
+        if steptime:
+            model.training_metrics["steptime"] = steptime
         if packed and packed_slots:
             # Packed fill = live pairs / dispatched pair slots — the
             # effective mask density of the packed dispatches (the grid
@@ -1313,6 +1319,9 @@ class Word2Vec:
         logger.info("training done: %s", metrics.summary())
         model = self._make_model(vocab, engine)
         model.training_metrics = {**metrics.summary(), "pipeline": "host"}
+        steptime = obs_run.steptime_totals()
+        if steptime:
+            model.training_metrics["steptime"] = steptime
         return model
 
     # Hooks specialized by subword/other model families (models/fasttext.py).

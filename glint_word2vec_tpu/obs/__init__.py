@@ -35,11 +35,12 @@ from glint_word2vec_tpu.obs import events
 from glint_word2vec_tpu.obs.canary import DivergenceCanary, TrainingDiverged
 from glint_word2vec_tpu.obs.events import EventRecorder
 from glint_word2vec_tpu.obs.heartbeat import HeartbeatServer, TrainingStatus
+from glint_word2vec_tpu.utils.metrics import StepTimeLedger
 
 __all__ = [
     "DivergenceCanary", "EventRecorder", "HeartbeatServer", "NULL_RUN",
-    "ObsConfig", "ObsRun", "TrainingDiverged", "TrainingStatus",
-    "start_run",
+    "ObsConfig", "ObsRun", "StepTimeLedger", "TrainingDiverged",
+    "TrainingStatus", "start_run",
 ]
 
 logger = logging.getLogger(__name__)
@@ -78,6 +79,12 @@ class ObsConfig:
     #: sync (blocking the async dispatch pipeline), so keep >> 1 on
     #: real runs; 1 checks every group.
     canary_check_every: int = 32
+    #: Per-run step-time attribution artifact (STEPTIME.json): the
+    #: ledger's phase breakdown + per-phase quantiles, written
+    #: atomically at run end. The ledger itself runs whenever
+    #: observability is enabled (it rides the fit loops' ObsRun.span
+    #: hooks); this only controls the file dump.
+    steptime_path: Optional[str] = None
     #: Filled in by start_run when a heartbeat server binds.
     bound_port: Optional[int] = None
 
@@ -92,7 +99,49 @@ class ObsConfig:
             or self.status_port is not None
             or self.status_file
             or self.canary != "off"
+            or self.steptime_path
         )
+
+
+#: Span-name -> ledger-phase map for the step-time attribution ledger
+#: (ISSUE 8). Only these fit-thread spans are accounted — nested or
+#: engine-internal spans (subword_expand inside device_steps, ckpt_write
+#: on the writer thread) are deliberately absent so phase totals stay a
+#: non-overlapping decomposition of the fit thread's wall clock.
+_LEDGER_PHASE_OF = {
+    "device_steps": "dispatch",
+    "readback_harvest": "readback_harvest",
+    "host_batch": "producer_wait",
+    "subsample_compact": "compact",
+    "subsample_prefetch": "compact",
+    "ckpt_snapshot": "checkpoint",
+    "checkpoint_save": "checkpoint",
+    "checkpoint_restore": "checkpoint",
+    "upload_corpus": "other",
+}
+
+
+class _LedgerSpan:
+    """Context manager charging a span's wall time to a ledger phase on
+    top of (optionally) recording it. ``with`` yields the inner span so
+    ``span.update(...)`` keeps working at instrumentation sites."""
+
+    __slots__ = ("_ledger", "_phase", "_inner", "_t0")
+
+    def __init__(self, ledger, phase: str, inner):
+        self._ledger = ledger
+        self._phase = phase
+        self._inner = inner
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        self._ledger.account(
+            self._phase, time.perf_counter() - self._t0
+        )
+        return self._inner.__exit__(*exc)
 
 
 class _NullRun:
@@ -103,12 +152,16 @@ class _NullRun:
     canary = None
     status = None
     server = None
+    ledger = None
 
     def span(self, name: str, **args):
         return events.NULL_SPAN
 
     def event(self, name: str, **args) -> None:
         pass
+
+    def steptime_totals(self):
+        return None
 
     def attach_metrics(self, metrics) -> None:
         pass
@@ -140,6 +193,10 @@ class ObsRun:
             EventRecorder(config.event_capacity, config.event_log)
             if config.wants_recorder else None
         )
+        #: Step-time attribution ledger (ISSUE 8): every phase-mapped
+        #: ObsRun.span charges it, so the breakdown exists whenever
+        #: observability is on — heartbeat, Prometheus, STEPTIME.json.
+        self.ledger = StepTimeLedger()
         self._prev_recorder = events.get_recorder()
         events.set_recorder(self.recorder)
         try:
@@ -151,7 +208,7 @@ class ObsRun:
             self.status = TrainingStatus(
                 pipeline=pipeline, total_epochs=total_epochs,
                 total_words=total_words, engine=engine,
-                recorder=self.recorder,
+                recorder=self.recorder, ledger=self.ledger,
             )
             if self.canary is not None:
                 self.status.set_canary(config.canary, 0, None)
@@ -190,9 +247,21 @@ class ObsRun:
     # -- hooks for the fit loops ---------------------------------------
 
     def span(self, name: str, **args):
-        if self.recorder is None:
-            return events.NULL_SPAN
-        return self.recorder.span(name, **args)
+        inner = (
+            self.recorder.span(name, **args)
+            if self.recorder is not None else events.NULL_SPAN
+        )
+        phase = _LEDGER_PHASE_OF.get(name)
+        if phase is None:
+            return inner
+        return _LedgerSpan(self.ledger, phase, inner)
+
+    def steptime_totals(self) -> dict:
+        """{phase: seconds} (unattributed gap folded into ``other``) —
+        what the fit loops surface in ``training_metrics``."""
+        return {
+            p: round(s, 3) for p, s in self.ledger.totals().items()
+        }
 
     def event(self, name: str, **args) -> None:
         if self.recorder is not None:
@@ -258,6 +327,12 @@ class ObsRun:
             atomic_write_json(path, self.status.snapshot())
         except OSError as e:
             logger.warning("status-file write to %s failed: %s", path, e)
+        # Ride the (throttled) status cadence to keep the JSONL event
+        # sink near-current on disk: a SIGKILLed worker's last ~1s of
+        # events is then recoverable by the supervisor's crash flight
+        # recorder (the postmortem bundle copies the sink file).
+        if self.recorder is not None:
+            self.recorder.flush()
 
     def close(self, failed: bool = False) -> None:
         """Idempotent teardown: final state, Chrome-trace export, JSONL
@@ -281,6 +356,15 @@ class ObsRun:
             state = "done"
         self.status.update(state=state)
         self.event("run_end", state=state)
+        self.ledger.finalize()
+        if self.config.steptime_path:
+            try:
+                self.ledger.dump(self.config.steptime_path)
+            except OSError as e:
+                logger.warning(
+                    "STEPTIME dump to %s failed: %s",
+                    self.config.steptime_path, e,
+                )
         if self.recorder is not None:
             if self.config.chrome_trace:
                 self.recorder.export_chrome_trace(self.config.chrome_trace)

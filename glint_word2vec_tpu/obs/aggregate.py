@@ -1,0 +1,440 @@
+"""Fleet-level observability: merge per-rank snapshots into ONE gang view.
+
+PR 3 gave every *process* a heartbeat and a Prometheus endpoint; PR 7
+made the trainer a supervised multi-process gang — but nothing saw the
+gang as one run. This module is that layer (ISSUE 8):
+
+- :func:`merge_training_snapshots` folds the per-rank heartbeat
+  snapshots (the ``--status-file`` JSON each worker already writes) into
+  one gang document: summed counters, total words/sec, per-rank
+  progress, a straggler-skew gauge (``rank_skew`` = max/median of the
+  per-rank mean step time — the signal that dominates distributed SGNS
+  scaling, Ji et al. arXiv:1604.04661), and the step-time attribution
+  ledger merged across ranks (exact histogram-bucket merges via
+  :meth:`LatencyHistogram.merge`).
+- :func:`merge_serving_snapshots` does the same for serving replicas:
+  endpoint latency histograms merge bucket-exactly, counters sum — the
+  result has the exact shape of one ``ServingMetrics.snapshot`` so the
+  existing Prometheus renderer serves a whole replica fleet unchanged.
+- :class:`GangStatusServer` is the HTTP face the supervisor parks next
+  to its liveness loop: one merged ``/metrics`` (JSON +
+  ``?format=prometheus``) and ``/healthz`` for the whole gang,
+  generation-stamped so a scrape during a restart can never mix
+  pre-restart ranks into the current gang's view. Serving processes
+  join the aggregate by URL (``serving_urls``): their JSON snapshots
+  are scraped lazily per request, merged, and appended to the gang
+  exposition.
+
+Everything here is jax-free on purpose: it runs in the supervisor
+process, which never touches a device.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from glint_word2vec_tpu.utils.metrics import LEDGER_PHASES, LatencyHistogram
+
+logger = logging.getLogger(__name__)
+
+#: Heartbeat-snapshot keys summed into the gang ``counters`` block; the
+#: merged value of each is BY CONSTRUCTION the sum of the per-rank
+#: values (the acceptance contract a fleet dashboard can rely on).
+_SUM_COUNTERS = (
+    ("steps_total", "step"),
+    ("words_done_total", "words_done"),
+    ("query_compiles_total", "query_compiles"),
+    ("async_save_waits_total", "async_save_waits"),
+)
+
+#: Rank states that make the whole gang unhealthy on /healthz.
+_BAD_STATES = ("diverged", "failed", "unhealthy")
+
+
+def _mean_step_seconds(snap: dict) -> Optional[float]:
+    steps = snap.get("step") or 0
+    st = snap.get("step_time")
+    if not steps or st is None:
+        return None
+    return float(st) / float(steps)
+
+
+def merge_training_snapshots(
+    snaps: Dict[int, Optional[dict]],
+    *,
+    generation: Optional[int] = None,
+    num_workers: Optional[int] = None,
+) -> dict:
+    """One gang-level document from per-rank heartbeat snapshots.
+
+    ``snaps`` maps rank -> snapshot (None = that rank has produced no
+    current-generation heartbeat yet). Snapshots stamped with a
+    different ``supervisor_generation`` than ``generation`` are dropped
+    here as a second line of defense (the supervisor's reader already
+    filters) — a pre-restart scrape must never pollute the merged view.
+    """
+    live: Dict[int, dict] = {}
+    for rank, snap in snaps.items():
+        if snap is None:
+            continue
+        gen = snap.get("supervisor_generation")
+        if generation is not None and gen is not None and int(gen) != generation:
+            continue
+        live[int(rank)] = snap
+
+    counters = {out: 0 for out, _ in _SUM_COUNTERS}
+    counters["canary_trips_total"] = 0
+    counters["events_recorded_total"] = 0
+    counters["events_dropped_total"] = 0
+    per_rank: Dict[str, dict] = {}
+    wps_total = 0.0
+    step_means: List[float] = []
+    phase_acc = {
+        p: {"seconds": 0.0, "count": 0, "hists": []} for p in LEDGER_PHASES
+    }
+    states = []
+    for rank in sorted(live):
+        snap = live[rank]
+        states.append(snap.get("state", "unknown"))
+        for out, key in _SUM_COUNTERS:
+            counters[out] += int(snap.get(key) or 0)
+        counters["canary_trips_total"] += int(
+            (snap.get("canary") or {}).get("trips") or 0
+        )
+        ev = snap.get("events") or {}
+        counters["events_recorded_total"] += int(ev.get("recorded") or 0)
+        counters["events_dropped_total"] += int(ev.get("dropped") or 0)
+        wps = float(snap.get("words_per_sec_rolling") or 0.0)
+        wps_total += wps
+        ms = _mean_step_seconds(snap)
+        if ms is not None:
+            step_means.append(ms)
+        per_rank[str(rank)] = {
+            "state": snap.get("state"),
+            "epoch": snap.get("epoch"),
+            "step": snap.get("step") or 0,
+            "words_done": snap.get("words_done") or 0,
+            "words_per_sec_rolling": wps,
+            "mean_step_seconds": (
+                round(ms, 6) if ms is not None else None
+            ),
+            "host_frac": snap.get("host_frac"),
+            "last_loss": snap.get("last_loss"),
+            "uptime_seconds": snap.get("uptime_seconds"),
+            "device_stall_seconds": snap.get("device_stall_seconds"),
+        }
+        st = (snap.get("steptime") or {}).get("phases") or {}
+        for p in LEDGER_PHASES:
+            info = st.get(p)
+            if not info:
+                continue
+            phase_acc[p]["seconds"] += float(info.get("seconds") or 0.0)
+            phase_acc[p]["count"] += int(info.get("count") or 0)
+            if info.get("hist"):
+                phase_acc[p]["hists"].append(info["hist"])
+
+    # Straggler skew: the slowest rank's mean step time over the gang
+    # median. 1.0 = perfectly balanced; the gauge the ROADMAP's
+    # pod-scale item watches. None until at least one rank reports step
+    # timing (rendered NaN in the Prometheus exposition).
+    rank_skew = None
+    if step_means:
+        med = statistics.median(step_means)
+        if med > 0:
+            rank_skew = round(max(step_means) / med, 4)
+
+    if not states:
+        gang_state = "starting"
+    elif any(s in _BAD_STATES for s in states):
+        gang_state = next(s for s in states if s in _BAD_STATES)
+    elif all(s == "done" for s in states):
+        gang_state = "done"
+    else:
+        gang_state = "running"
+
+    steptime = {}
+    for p in LEDGER_PHASES:
+        acc = phase_acc[p]
+        entry = {
+            "seconds": round(acc["seconds"], 4),
+            "count": acc["count"],
+        }
+        if acc["hists"]:
+            h = LatencyHistogram.merge(acc["hists"])
+            entry.update(
+                p50_ms=round(h.quantile(0.50) * 1e3, 3),
+                p95_ms=round(h.quantile(0.95) * 1e3, 3),
+                p99_ms=round(h.quantile(0.99) * 1e3, 3),
+                # Accounted-span seconds only (the histogram's own
+                # total): for "other" this EXCLUDES the folded
+                # unattributed gap, so the Prometheus summary's _sum,
+                # _count, and quantiles describe the same population.
+                span_seconds=round(h.total, 4),
+            )
+        steptime[p] = entry
+
+    return {
+        "generation": generation,
+        "num_workers": (
+            num_workers if num_workers is not None else len(snaps)
+        ),
+        "ranks_reporting": len(live),
+        "state": gang_state,
+        "counters": counters,
+        "words_per_sec_total": round(wps_total, 1),
+        "rank_skew": rank_skew,
+        "per_rank": per_rank,
+        "steptime": steptime,
+    }
+
+
+def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
+    """Merge serving-replica ``ServingMetrics.snapshot`` documents into
+    one with the identical shape, so ``serving_to_prometheus`` renders a
+    whole replica fleet with no second code path. Latency histograms
+    merge bucket-exactly when snapshots carry ``hist`` state (this
+    repo's do); a hist-less legacy snapshot degrades that endpoint's
+    quantiles to the max across replicas (conservative, flagged via
+    ``"approx": true``). Returns None for an empty input."""
+    snaps = [s for s in snaps if s]
+    if not snaps:
+        return None
+    endpoints: Dict[str, dict] = {}
+    ep_hists: Dict[str, list] = {}
+    for s in snaps:
+        for path, ep in (s.get("endpoints") or {}).items():
+            agg = endpoints.setdefault(path, {
+                "count": 0, "errors": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+                "p99_ms": 0.0, "mean_ms": 0.0, "max_ms": 0.0,
+                "_total_ms": 0.0,
+            })
+            agg["count"] += int(ep.get("count") or 0)
+            agg["errors"] += int(ep.get("errors") or 0)
+            agg["max_ms"] = max(agg["max_ms"], float(ep.get("max_ms") or 0.0))
+            agg["_total_ms"] += (
+                float(ep.get("mean_ms") or 0.0) * int(ep.get("count") or 0)
+            )
+            # Max-fold EVERY replica's quantiles (the approx fallback
+            # must cover the whole fleet, hist-carrying replicas
+            # included — dropping a slow replica's p99 because its peer
+            # is legacy would hide the straggler); the exact merge
+            # below overwrites when every replica carried hist state.
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                agg[q] = max(agg[q], float(ep.get(q) or 0.0))
+            if ep.get("hist"):
+                ep_hists.setdefault(path, []).append(ep["hist"])
+            else:
+                agg["approx"] = True
+    for path, agg in endpoints.items():
+        hists = ep_hists.get(path)
+        if hists and not agg.get("approx"):
+            h = LatencyHistogram.merge(hists)
+            agg.update(
+                p50_ms=round(h.quantile(0.50) * 1e3, 3),
+                p95_ms=round(h.quantile(0.95) * 1e3, 3),
+                p99_ms=round(h.quantile(0.99) * 1e3, 3),
+                hist=h.state(),
+            )
+        agg["mean_ms"] = round(
+            agg.pop("_total_ms") / max(agg["count"], 1), 3
+        )
+
+    batches: Dict[str, int] = {}
+    cache = {"hits": 0, "misses": 0}
+    over = {
+        "shed_admission_total": 0, "shed_degraded_total": 0,
+        "deadline_504_total": 0, "degraded_entered_total": 0,
+        "inflight_peak": 0,
+    }
+    compiles = {"total": 0, "warmup": 0, "post_warmup": 0}
+    ck = {
+        "pending_async_saves": 0,
+        "last_checkpoint_age_seconds": None,
+        "checkpoint_write_seconds": None,
+    }
+    for s in snaps:
+        for size, n in (s.get("coalesced_batch_sizes") or {}).items():
+            batches[size] = batches.get(size, 0) + int(n)
+        c = s.get("synonym_cache") or {}
+        cache["hits"] += int(c.get("hits") or 0)
+        cache["misses"] += int(c.get("misses") or 0)
+        o = s.get("overload") or {}
+        for k in over:
+            v = int(o.get(k) or 0)
+            if k == "inflight_peak":
+                over[k] = max(over[k], v)
+            else:
+                over[k] += v
+        comp = s.get("compiles") or {}
+        for k in compiles:
+            compiles[k] += int(comp.get(k) or 0)
+        sck = s.get("checkpoint") or {}
+        ck["pending_async_saves"] += int(sck.get("pending_async_saves") or 0)
+        for k in ("last_checkpoint_age_seconds",
+                  "checkpoint_write_seconds"):
+            v = sck.get(k)
+            if v is not None:
+                # Worst (largest) across replicas: the stalest
+                # checkpoint and the slowest write are the actionable
+                # fleet numbers.
+                ck[k] = v if ck[k] is None else max(ck[k], v)
+    return {
+        "replicas": len(snaps),
+        "endpoints": {p: endpoints[p] for p in sorted(endpoints)},
+        "coalesced_batch_sizes": {
+            k: batches[k] for k in sorted(batches, key=int)
+        },
+        "synonym_cache": cache,
+        "overload": over,
+        "compiles": compiles,
+        "checkpoint": ck,
+    }
+
+
+class GangStatusServer:
+    """Merged gang ``/metrics`` + ``/healthz`` for the supervisor.
+
+    The supervisor feeds it each liveness sweep via :meth:`update`
+    (generation + per-rank snapshots); requests serve the merge of the
+    latest sweep. ``serving_urls`` are scraped lazily per request (2s
+    timeout each, failures reported in ``serving_sources`` instead of
+    failing the scrape) and merged into a single serving section.
+
+    Routes:
+      GET /healthz                   -> gang state (200, or 503 when any
+                                        rank is diverged/failed/unhealthy)
+      GET /metrics                   -> merged gang JSON (+ "serving")
+      GET /metrics?format=prometheus -> gang exposition, serving fleet
+                                        exposition appended when joined
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_workers: int = 1,
+                 serving_urls: Optional[List[str]] = None):
+        from glint_word2vec_tpu.obs.prometheus import (
+            gang_to_prometheus,
+            serving_to_prometheus,
+        )
+
+        self.num_workers = int(num_workers)
+        self.serving_urls = list(serving_urls or [])
+        self._mu = threading.Lock()
+        self._generation = 0
+        self._snaps: Dict[int, Optional[dict]] = {}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("gang-metrics: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/healthz":
+                    merged = server.merged(include_serving=False)
+                    ok = merged["state"] not in _BAD_STATES
+                    body = json.dumps({
+                        "status": "ok" if ok else merged["state"],
+                        "state": merged["state"],
+                        "generation": merged["generation"],
+                        "num_workers": merged["num_workers"],
+                        "ranks_reporting": merged["ranks_reporting"],
+                        "words_per_sec_total":
+                            merged["words_per_sec_total"],
+                        "rank_skew": merged["rank_skew"],
+                    }).encode()
+                    self._send(200 if ok else 503, body,
+                               "application/json")
+                elif url.path == "/metrics":
+                    merged = server.merged()
+                    fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    if fmt == "prometheus":
+                        text = gang_to_prometheus(merged)
+                        if merged.get("serving"):
+                            text += serving_to_prometheus(
+                                merged["serving"]
+                            )
+                        self._send(
+                            200, text.encode(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    else:
+                        self._send(200, json.dumps(merged).encode(),
+                                   "application/json")
+                else:
+                    self._send(
+                        404,
+                        json.dumps(
+                            {"error": f"no route {url.path}"}
+                        ).encode(),
+                        "application/json",
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- supervisor-facing ---------------------------------------------
+
+    def update(self, generation: int,
+               snaps: Dict[int, Optional[dict]]) -> None:
+        """Install the latest liveness sweep's per-rank snapshots. The
+        generation stamps the merged view; snapshots the supervisor
+        read are already generation-filtered."""
+        with self._mu:
+            self._generation = int(generation)
+            self._snaps = dict(snaps)
+
+    def merged(self, include_serving: bool = True) -> dict:
+        with self._mu:
+            gen, snaps = self._generation, dict(self._snaps)
+        merged = merge_training_snapshots(
+            snaps, generation=gen, num_workers=self.num_workers
+        )
+        if include_serving and self.serving_urls:
+            serving, sources = self._scrape_serving()
+            merged["serving"] = serving
+            merged["serving_sources"] = sources
+        return merged
+
+    def _scrape_serving(self):
+        """Fetch each joined serving replica's JSON /metrics snapshot.
+        A dead replica is reported, never fatal — the gang view must
+        stay up while a replica restarts."""
+        snaps, sources = [], {}
+        for url in self.serving_urls:
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as r:
+                    snaps.append(json.loads(r.read().decode()))
+                sources[url] = "ok"
+            except Exception as e:  # URLError, timeout, bad JSON
+                sources[url] = f"error: {e}"
+        return merge_serving_snapshots(snaps), sources
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="glint-gang-metrics",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

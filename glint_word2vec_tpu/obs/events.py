@@ -99,6 +99,19 @@ class EventRecorder:
         self.wall_t0 = time.time()
         self._t0 = time.perf_counter()
         self._sink = open(jsonl_path, "w") if jsonl_path else None
+        if self._sink is not None:
+            # Clock-anchor metadata line (Chrome-trace "M" event, ignored
+            # by viewers): maps this recorder's monotonic ts=0 back to
+            # the epoch, so scripts/trace_summarize.py --merge-ranks can
+            # align per-rank JSONLs onto one shared timeline.
+            try:
+                self._sink.write(json.dumps({
+                    "name": "clock_anchor", "ph": "M", "ts": 0,
+                    "pid": os.getpid(),
+                    "args": {"wall_t0": self.wall_t0},
+                }) + "\n")
+            except OSError as e:
+                self._drop_sink_locked(e)
 
     @property
     def capacity(self) -> int:

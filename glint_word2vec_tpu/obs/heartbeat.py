@@ -87,8 +87,12 @@ class TrainingStatus:
 
     def __init__(self, *, pipeline: str = "", total_epochs: int = 0,
                  total_words: int = 0, metrics=None, engine=None,
-                 recorder=None):
+                 recorder=None, ledger=None):
         self._mu = threading.Lock()
+        #: Optional utils.metrics.StepTimeLedger — the step-time
+        #: attribution breakdown surfaced under ``steptime`` in every
+        #: snapshot (and merged across ranks by obs.aggregate).
+        self._ledger = ledger
         self.pipeline = pipeline
         self.total_epochs = int(total_epochs)
         self.total_words = int(total_words)
@@ -117,7 +121,8 @@ class TrainingStatus:
             self.supervisor_generation = None
         self._rolling: deque = deque(maxlen=self.ROLLING)
 
-    def attach(self, *, metrics=None, engine=None, recorder=None) -> None:
+    def attach(self, *, metrics=None, engine=None, recorder=None,
+               ledger=None) -> None:
         with self._mu:
             if metrics is not None:
                 self._metrics = metrics
@@ -125,6 +130,8 @@ class TrainingStatus:
                 self._engine = engine
             if recorder is not None:
                 self._recorder = recorder
+            if ledger is not None:
+                self._ledger = ledger
 
     def update(self, *, epoch=None, step=None, words_done=None, alpha=None,
                state=None) -> None:
@@ -166,6 +173,7 @@ class TrainingStatus:
     def snapshot(self, include_devices: bool = True) -> dict:
         with self._mu:
             m, eng, rec = self._metrics, self._engine, self._recorder
+            ledger = self._ledger
             snap = {
                 "state": self.state,
                 "pipeline": self.pipeline,
@@ -219,6 +227,10 @@ class TrainingStatus:
                 )
         if rec is not None:
             snap["events"] = rec.counts()
+        if ledger is not None:
+            # Step-time attribution (ISSUE 8): per-phase wall seconds
+            # with the histogram state the gang aggregator merges.
+            snap["steptime"] = ledger.snapshot()
         if include_devices:
             snap["device_memory"] = device_memory_stats()
         return snap

@@ -136,6 +136,19 @@ def training_to_prometheus(snap: dict) -> str:
                "Events evicted from the bounded ring.")
         p.sample("glint_obs_events_dropped_total", None,
                  events.get("dropped", 0))
+    steptime = (snap.get("steptime") or {}).get("phases") or {}
+    if steptime:
+        p.head("glint_training_steptime_seconds", "gauge",
+               "Step-time attribution ledger: fit-thread wall seconds "
+               "by phase (unattributed gap folded into 'other').")
+        for phase, info in steptime.items():
+            p.sample("glint_training_steptime_seconds",
+                     {"phase": phase}, info.get("seconds"))
+        p.head("glint_training_steptime_ops_total", "counter",
+               "Accounted spans per ledger phase.")
+        for phase, info in steptime.items():
+            p.sample("glint_training_steptime_ops_total",
+                     {"phase": phase}, info.get("count", 0))
     mem = snap.get("device_memory") or {}
     if mem:
         p.head("glint_device_memory_bytes", "gauge",
@@ -144,6 +157,102 @@ def training_to_prometheus(snap: dict) -> str:
             for stat, val in sorted(stats.items()):
                 p.sample("glint_device_memory_bytes",
                          {"device": dev, "stat": stat}, val)
+    return p.text()
+
+
+# ----------------------------------------------------------------------
+# Gang exposition (obs/aggregate.merge_training_snapshots)
+# ----------------------------------------------------------------------
+
+
+def gang_to_prometheus(snap: dict) -> str:
+    """Render the merged gang snapshot as scrape-ready text: gang
+    counters (sums of the per-rank values), total words/sec, the
+    rank-skew straggler gauge, per-rank progress gauges, and the merged
+    step-time attribution ledger. The caller appends the merged serving
+    exposition when serving replicas joined the aggregate (distinct
+    ``glint_serving_*`` names, so the concatenation stays lint-clean)."""
+    p = _Prom()
+    p.head("glint_gang_info", "gauge",
+           "Gang metadata carried as labels; value is always 1.")
+    p.sample("glint_gang_info", {"state": snap.get("state", "")}, 1)
+    gauges = [
+        ("glint_gang_generation", "generation",
+         "Supervisor launch generation the merged view reflects."),
+        ("glint_gang_num_workers", "num_workers",
+         "Configured gang size."),
+        ("glint_gang_ranks_reporting", "ranks_reporting",
+         "Ranks with a current-generation heartbeat in the last sweep."),
+        ("glint_gang_words_per_sec", "words_per_sec_total",
+         "Sum of per-rank rolling trained-words/sec."),
+        ("glint_gang_rank_skew", "rank_skew",
+         "Straggler skew: max/median of per-rank mean step seconds "
+         "(1.0 = balanced; NaN until ranks report step timing)."),
+    ]
+    for name, key, help_ in gauges:
+        p.head(name, "gauge", help_)
+        p.sample(name, None, snap.get(key))
+    counters = snap.get("counters") or {}
+    for name, help_ in (
+        ("steps_total", "Optimizer steps summed over ranks."),
+        ("words_done_total", "Trained words summed over ranks."),
+        ("query_compiles_total",
+         "Engine query-shape compiles summed over ranks."),
+        ("async_save_waits_total",
+         "Checkpoint back-pressure waits summed over ranks."),
+        ("canary_trips_total",
+         "Divergence-canary trips summed over ranks."),
+        ("events_recorded_total",
+         "Obs events recorded summed over ranks."),
+        ("events_dropped_total",
+         "Obs ring evictions summed over ranks."),
+    ):
+        p.head(f"glint_gang_{name}", "counter", help_)
+        p.sample(f"glint_gang_{name}", None, counters.get(name, 0))
+    per_rank = snap.get("per_rank") or {}
+    p.head("glint_gang_rank_words_per_sec", "gauge",
+           "Per-rank rolling trained-words/sec.")
+    for rank, r in per_rank.items():
+        p.sample("glint_gang_rank_words_per_sec", {"rank": rank},
+                 r.get("words_per_sec_rolling"))
+    p.head("glint_gang_rank_mean_step_seconds", "gauge",
+           "Per-rank mean seconds per optimizer step (the rank_skew "
+           "numerator/denominator population).")
+    for rank, r in per_rank.items():
+        p.sample("glint_gang_rank_mean_step_seconds", {"rank": rank},
+                 r.get("mean_step_seconds"))
+    p.head("glint_gang_rank_words_done", "gauge",
+           "Per-rank trained-words counter.")
+    for rank, r in per_rank.items():
+        p.sample("glint_gang_rank_words_done", {"rank": rank},
+                 r.get("words_done", 0))
+    steptime = snap.get("steptime") or {}
+    if steptime:
+        p.head("glint_gang_steptime_seconds", "gauge",
+               "Merged step-time attribution: fit-thread wall seconds "
+               "by phase, summed over ranks.")
+        for phase, info in steptime.items():
+            p.sample("glint_gang_steptime_seconds", {"phase": phase},
+                     info.get("seconds"))
+        p.head("glint_gang_steptime_span_seconds", "summary",
+               "Merged per-span duration quantiles by ledger phase "
+               "(bucket-exact cross-rank histogram merge).")
+        for phase, info in steptime.items():
+            if "p50_ms" not in info:
+                continue
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                p.sample("glint_gang_steptime_span_seconds",
+                         {"phase": phase, "quantile": q},
+                         info[key] / 1e3)
+            # span_seconds, not the phase total: the phase total folds
+            # the unattributed wall gap into "other", which would make
+            # sum/count disagree with this summary's own quantiles.
+            p.sample("glint_gang_steptime_span_seconds_sum",
+                     {"phase": phase},
+                     info.get("span_seconds", info.get("seconds")))
+            p.sample("glint_gang_steptime_span_seconds_count",
+                     {"phase": phase}, info.get("count", 0))
     return p.text()
 
 

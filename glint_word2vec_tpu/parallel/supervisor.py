@@ -68,9 +68,21 @@ def cli_train_build_argv(train_rest: List[str]) -> BuildArgv:
     import sys
 
     def build_argv(rank, n, port, status_file, generation):
+        status_dir = os.path.dirname(status_file)
         argv = [
             sys.executable, "-m", "glint_word2vec_tpu.cli", "train",
             *train_rest, "--status-file", status_file,
+            # Crash flight recorder (ISSUE 8): every worker mirrors its
+            # event ring to a per-rank JSONL (flushed on the status
+            # cadence) and dumps its step-time ledger at run end, so
+            # the supervisor can collect a postmortem bundle even for
+            # a SIGKILLed or wedged rank. Appended AFTER the operator's
+            # train args, so these supervisor-owned paths win argparse's
+            # last-value-wins if the operator also set them.
+            "--event-log",
+            os.path.join(status_dir, f"events-{rank}.jsonl"),
+            "--steptime-out",
+            os.path.join(status_dir, f"steptime-{rank}.json"),
         ]
         if n > 1:
             argv += [
@@ -94,6 +106,11 @@ class RestartRecord:
     #: rebuild, checkpoint restore). None when no heartbeat arrived
     #: before the run ended (very short tails).
     detect_to_heartbeat_seconds: Optional[float] = None
+    #: Crash-flight-recorder bundles collected from the FAILED
+    #: generation (postmortem-<gen>-<rank>/ under status_dir): each
+    #: holds that rank's last heartbeat snapshot, event-ring JSONL,
+    #: step-time ledger, and worker-log tail.
+    postmortem: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -108,6 +125,7 @@ class RestartRecord:
                 round(self.detect_to_heartbeat_seconds, 3)
                 if self.detect_to_heartbeat_seconds is not None else None
             ),
+            "postmortem": list(self.postmortem),
         }
 
 
@@ -119,6 +137,12 @@ class SupervisorReport:
     gave_up_reason: Optional[str] = None
     wall_seconds: float = 0.0
     restart_records: List[RestartRecord] = field(default_factory=list)
+    #: EVERY flight-recorder bundle this run collected (restart AND
+    #: give-up teardowns), newest last — the one list an operator (or
+    #: scripts/chaos_drill.py) walks for post-incident forensics.
+    postmortem_bundles: List[str] = field(default_factory=list)
+    #: Bound port of the merged gang /metrics endpoint (None = off).
+    metrics_port: Optional[int] = None
 
     def to_dict(self) -> dict:
         return {
@@ -128,6 +152,8 @@ class SupervisorReport:
             "gave_up_reason": self.gave_up_reason,
             "wall_seconds": round(self.wall_seconds, 2),
             "restart_records": [r.to_dict() for r in self.restart_records],
+            "postmortem_bundles": list(self.postmortem_bundles),
+            "metrics_port": self.metrics_port,
         }
 
 
@@ -164,6 +190,16 @@ class Supervisor:
         How long a worker may run without producing its first
         current-generation heartbeat before that too is a hang
         (compilation can take minutes on cold starts — keep generous).
+    metrics_port:
+        Bind the merged gang observability endpoint here (0 =
+        ephemeral; the bound port is on ``self.metrics_port``): one
+        ``/metrics`` (JSON + Prometheus) + ``/healthz`` for the whole
+        gang, fed from the per-rank status files each liveness sweep,
+        generation-stamped. None (default) disables.
+    serving_urls:
+        Serving-replica JSON ``/metrics`` URLs to join into the merged
+        exposition (scraped lazily per request, replica failures
+        reported not fatal).
     """
 
     def __init__(
@@ -182,6 +218,9 @@ class Supervisor:
         backoff_base_seconds: float = 1.0,
         backoff_cap_seconds: float = 30.0,
         kill_grace_seconds: float = 5.0,
+        metrics_port: Optional[int] = None,
+        metrics_host: str = "127.0.0.1",
+        serving_urls: Optional[List[str]] = None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -200,6 +239,24 @@ class Supervisor:
         self.kill_grace_seconds = float(kill_grace_seconds)
         self._procs: List[Optional[subprocess.Popen]] = []
         self._logs: List = []
+        #: Merged gang observability endpoint (ISSUE 8). Bound in the
+        #: constructor so callers know the port before run() blocks.
+        self.gang_server = None
+        self.metrics_port: Optional[int] = None
+        if metrics_port is not None:
+            from glint_word2vec_tpu.obs.aggregate import GangStatusServer
+
+            self.gang_server = GangStatusServer(
+                host=metrics_host, port=metrics_port,
+                num_workers=self.num_workers, serving_urls=serving_urls,
+            )
+            self.gang_server.start()
+            self.metrics_port = self.gang_server.port
+            logger.info(
+                "supervisor: merged gang metrics on http://%s:%d "
+                "(/healthz, /metrics)",
+                self.gang_server.host, self.gang_server.port,
+            )
 
     # -- per-generation plumbing ----------------------------------------
 
@@ -343,6 +400,85 @@ class Supervisor:
                 )
         return None
 
+    # -- crash flight recorder ------------------------------------------
+
+    #: Worker-log tail bytes copied into each postmortem bundle.
+    POSTMORTEM_LOG_TAIL = 65536
+
+    def _collect_postmortem(self, generation: int, reason: str) -> List[str]:
+        """Flush each rank's on-disk observability remains into a
+        ``postmortem-<gen>-<rank>/`` bundle after a gang teardown: the
+        last heartbeat snapshot (``heartbeat.json``), the event-ring
+        JSONL the worker mirrored (``events.jsonl``), the step-time
+        ledger when the rank got far enough to dump one
+        (``steptime.json``), the worker-log tail (``log_tail.txt``),
+        and a ``meta.json`` naming the generation/rank/reason. A
+        SIGKILLed rank cannot flush anything itself — these files are
+        exactly why the launch contract writes them continuously.
+        Collection is best-effort and must never block a restart."""
+        import shutil
+
+        bundles = []
+        for rank in range(self.num_workers):
+            sources = [
+                (self._status_file(rank), "heartbeat.json"),
+                (os.path.join(self.status_dir, f"events-{rank}.jsonl"),
+                 "events.jsonl"),
+                (os.path.join(self.status_dir, f"steptime-{rank}.json"),
+                 "steptime.json"),
+            ]
+            if not any(os.path.exists(src) for src, _ in sources):
+                continue  # rank died before producing anything
+            bundle = os.path.join(
+                self.status_dir, f"postmortem-{generation}-{rank}"
+            )
+            try:
+                os.makedirs(bundle, exist_ok=True)
+                for src, dst in sources:
+                    if os.path.exists(src):
+                        shutil.copyfile(src, os.path.join(bundle, dst))
+                log_path = os.path.join(
+                    self.status_dir, f"worker-{rank}.log"
+                )
+                if os.path.exists(log_path):
+                    with open(log_path, "rb") as f:
+                        f.seek(0, os.SEEK_END)
+                        f.seek(max(0, f.tell() - self.POSTMORTEM_LOG_TAIL))
+                        tail = f.read()
+                    with open(
+                        os.path.join(bundle, "log_tail.txt"), "wb"
+                    ) as f:
+                        f.write(tail)
+                with open(os.path.join(bundle, "meta.json"), "w") as f:
+                    json.dump({
+                        "generation": generation,
+                        "rank": rank,
+                        "reason": reason,
+                        "collected_at": time.time(),
+                    }, f)
+            except OSError as e:
+                logger.warning(
+                    "supervisor: postmortem collection for rank %d "
+                    "failed: %s", rank, e,
+                )
+                continue
+            bundles.append(bundle)
+        if bundles:
+            logger.error(
+                "supervisor: flight-recorder bundles collected: %s",
+                ", ".join(bundles),
+            )
+        return bundles
+
+    def _update_gang_status(self, generation: int) -> None:
+        """Feed the merged-metrics server this sweep's per-rank view."""
+        if self.gang_server is None:
+            return
+        self.gang_server.update(generation, {
+            rank: self._read_status(rank, generation)
+            for rank in range(self.num_workers)
+        })
+
     def _resolve_checkpoint(self) -> Optional[str]:
         """Integrity-verified name of the snapshot the relaunch will
         resume from (None = fresh start). Raises
@@ -361,7 +497,7 @@ class Supervisor:
     # -- main loop ------------------------------------------------------
 
     def run(self) -> SupervisorReport:
-        report = SupervisorReport()
+        report = SupervisorReport(metrics_port=self.metrics_port)
         t0 = time.time()
         generation = 0
         pending_hb: Optional[RestartRecord] = None
@@ -371,6 +507,7 @@ class Supervisor:
             report.generations = 1
             launched_at = time.time()
             while True:
+                self._update_gang_status(generation)
                 if all(p.poll() == 0 for p in self._procs):
                     report.completed = True
                     logger.info(
@@ -397,6 +534,11 @@ class Supervisor:
                     "gang down", generation, reason,
                 )
                 self._kill_gang()
+                # Flight recorder: capture the failed generation's
+                # per-rank remains NOW — the relaunch reopens (and
+                # truncates) the per-rank event logs and status files.
+                bundles = self._collect_postmortem(generation, reason)
+                report.postmortem_bundles.extend(bundles)
                 if report.restarts >= self.max_restarts:
                     report.gave_up_reason = (
                         f"{reason} (restart budget {self.max_restarts} "
@@ -438,10 +580,15 @@ class Supervisor:
                     resumed_from=resumed_from,
                     backoff_seconds=backoff,
                     detect_to_relaunch_seconds=time.time() - detect_t,
+                    postmortem=bundles,
                 )
                 report.restart_records.append(rec)
                 pending_hb, hb_detect_t = rec, detect_t
         finally:
             self._kill_gang()
+            self._update_gang_status(generation)
+            if self.gang_server is not None:
+                self.gang_server.stop()
+                self.gang_server = None
             report.wall_seconds = time.time() - t0
         return report

@@ -200,6 +200,167 @@ class LatencyHistogram:
                 return lo + (hi - lo) * ((target - (acc - c)) / c)
         return self.max
 
+    # -- cross-process state / merging (ISSUE 8) -----------------------
+    # The gang aggregator merges per-rank histograms into one fleet
+    # histogram: bucket counts are position-aligned (every instance
+    # shares _EDGES), so merging is exact — the merged quantiles are
+    # what one histogram fed the whole population would report.
+
+    def state(self) -> dict:
+        """JSON-serializable snapshot of the histogram (sparse bucket
+        counts), for crossing a process boundary (heartbeat status
+        files, serving /metrics) into :meth:`from_state`/:meth:`merge`."""
+        return {
+            "counts": {
+                str(i): c for i, c in enumerate(self.counts) if c
+            },
+            "n": self.n,
+            "total": self.total,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LatencyHistogram":
+        h = cls()
+        for i, c in (state.get("counts") or {}).items():
+            i = int(i)
+            if 0 <= i < len(h.counts):
+                h.counts[i] += int(c)
+        h.n = int(state.get("n", 0))
+        h.total = float(state.get("total", 0.0))
+        h.max = float(state.get("max", 0.0))
+        return h
+
+    @classmethod
+    def merge(cls, hists) -> "LatencyHistogram":
+        """Merge histograms (objects or :meth:`state` dicts) into a new
+        one. Bucket-exact: recorded into parts then merged equals
+        recorded whole, except the quantile interpolation clamp, which
+        uses the merged (global) max."""
+        out = cls()
+        for h in hists:
+            if isinstance(h, dict):
+                h = cls.from_state(h)
+            for i, c in enumerate(h.counts):
+                out.counts[i] += c
+            out.n += h.n
+            out.total += h.total
+            if h.max > out.max:
+                out.max = h.max
+        return out
+
+
+#: The step-time attribution ledger's named phases (ISSUE 8). Fixed so
+#: dashboards, STEPTIME.json trends, and the gang aggregator never see a
+#: phase they don't know:
+#:   dispatch          — device train-step dispatch calls
+#:   readback_harvest  — converting a dispatched group's result scalars
+#:   producer_wait     — waiting on / running host batch production
+#:   compact           — on-device subsample-compact passes (+ prefetch
+#:                       dispatch)
+#:   checkpoint        — snapshot copies, blocking saves, restores
+#:   other             — explicitly-charged misc (corpus upload) plus
+#:                       the wall-clock gap no span covered
+LEDGER_PHASES = (
+    "dispatch", "readback_harvest", "producer_wait", "compact",
+    "checkpoint", "other",
+)
+
+
+class StepTimeLedger:
+    """Step-time attribution for one fit: every accounted span charges
+    its wall time to a named phase, replacing the single
+    ``device_stall_seconds`` proxy with a breakdown that says WHERE the
+    wall went (ISSUE 8). Fed by ``obs.ObsRun.span`` — the fit loops'
+    existing span instrumentation — so when observability is off the
+    cost is the NULL_SPAN path's single module-global read.
+
+    Per phase: total seconds, span count, and a :class:`LatencyHistogram`
+    of span durations (the gang aggregator merges these across ranks).
+    Thread-safe; in practice only the fit thread accounts (ObsRun.span
+    is a fit-loop hook — producer/writer threads use the module-level
+    recorder hooks, which bypass the ledger by design so writer-thread
+    time can never inflate a wall-clock-sum breakdown)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._t0 = time.time()
+        self._t_end: Optional[float] = None
+        self._seconds = {p: 0.0 for p in LEDGER_PHASES}
+        self._counts = {p: 0 for p in LEDGER_PHASES}
+        self._hists = {p: LatencyHistogram() for p in LEDGER_PHASES}
+
+    def account(self, phase: str, seconds: float) -> None:
+        with self._mu:
+            self._seconds[phase] += seconds
+            self._counts[phase] += 1
+            self._hists[phase].record(seconds)
+
+    def finalize(self) -> None:
+        """Freeze the wall clock (run end); later snapshots stop growing
+        ``other``. Idempotent — first call wins."""
+        with self._mu:
+            if self._t_end is None:
+                self._t_end = time.time()
+
+    def wall_seconds(self) -> float:
+        with self._mu:
+            return (self._t_end or time.time()) - self._t0
+
+    def totals(self) -> Dict[str, float]:
+        """{phase: seconds} with the unattributed wall gap folded into
+        ``other`` — the phases sum to the ledger's wall clock."""
+        snap = self.snapshot(include_hists=False)
+        return {
+            p: info["seconds"] for p, info in snap["phases"].items()
+        }
+
+    def snapshot(self, include_hists: bool = True) -> dict:
+        """Full breakdown: wall, per-phase seconds/count (+ histogram
+        state for cross-rank merging), and the unattributed gap, which
+        is folded into ``other`` so the phase totals always sum to the
+        wall clock."""
+        with self._mu:
+            wall = (self._t_end or time.time()) - self._t0
+            accounted = sum(self._seconds.values())
+            gap = max(0.0, wall - accounted)
+            phases = {}
+            for p in LEDGER_PHASES:
+                info = {
+                    "seconds": round(
+                        self._seconds[p] + (gap if p == "other" else 0.0),
+                        4,
+                    ),
+                    "count": self._counts[p],
+                }
+                if include_hists:
+                    info["hist"] = self._hists[p].state()
+                phases[p] = info
+            return {
+                "wall_seconds": round(wall, 4),
+                "accounted_seconds": round(accounted, 4),
+                "unattributed_seconds": round(gap, 4),
+                "phases": phases,
+            }
+
+    def dump(self, path: str) -> None:
+        """Write the per-run STEPTIME.json artifact (atomic): the phase
+        breakdown plus per-phase span-duration quantiles, so bench
+        trends can attribute a regression to a phase."""
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        snap = self.snapshot(include_hists=False)
+        with self._mu:
+            for p in LEDGER_PHASES:
+                h = self._hists[p]
+                snap["phases"][p].update(
+                    p50_ms=round(h.quantile(0.50) * 1e3, 3),
+                    p95_ms=round(h.quantile(0.95) * 1e3, 3),
+                    p99_ms=round(h.quantile(0.99) * 1e3, 3),
+                )
+        snap["schema_version"] = 1
+        atomic_write_json(path, snap)
+
 
 class ServingMetrics:
     """Serving-path observability for ``serving.ModelServer``:
@@ -303,6 +464,10 @@ class ServingMetrics:
                     "p99_ms": round(h.quantile(0.99) * 1e3, 3),
                     "mean_ms": round(h.total / max(h.n, 1) * 1e3, 3),
                     "max_ms": round(h.max * 1e3, 3),
+                    # Raw histogram state: quantiles cannot be merged,
+                    # bucket counts can — the fleet aggregator combines
+                    # replica snapshots exactly (obs.aggregate).
+                    "hist": h.state(),
                 }
             return {
                 "endpoints": endpoints,

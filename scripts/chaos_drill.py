@@ -1,20 +1,31 @@
 """Chaos drill: a supervised fit under a scripted kill schedule.
 
-The executable proof of ISSUE 7's fault-domain layer: launch a training
-gang under ``parallel.supervisor.Supervisor``, arm a deterministic
-``GLINT_FAULTS`` kill on rank 0 (``worker.step:kill@G`` — SIGKILL at the
-G-th dispatch group, placed early in epoch 2 so at least one checkpoint
-has committed), and assert the whole story end to end:
+The executable proof of ISSUE 7's fault-domain layer AND ISSUE 8's
+fleet-observability layer: run ``cli supervise`` (the real operator
+entry point) over a training gang, arm a deterministic ``GLINT_FAULTS``
+kill on rank 0 (``worker.step:kill@G`` — SIGKILL at the G-th dispatch
+group, placed early in epoch 2 so at least one checkpoint has
+committed), and assert the whole story end to end:
 
   * the supervisor detects the crash, tears the gang down (the surviving
     rank is wedged in a collective — exactly the hang this layer exists
     for), and relaunches exactly once;
   * the relaunch resumes from the last committed checkpoint
     (integrity-verified through ``utils.integrity.resolve_train_state``);
+  * while the gang trains, the supervisor's MERGED ``/metrics`` endpoint
+    answers with gang counters that equal the sum of the per-rank values
+    and a ``rank_skew`` straggler gauge, and its Prometheus rendering
+    lints clean;
+  * the kill leaves a ``postmortem-0-0/`` flight-recorder bundle holding
+    rank 0's event ring + last heartbeat, referenced from the
+    supervisor's JSON report (``--report-out`` — this script consumes
+    that report instead of re-deriving anything);
+  * the per-rank event JSONLs merge into one rank-laned Chrome trace
+    (``trace_summarize.py --merge-ranks``) with one lane per rank;
   * the fit completes and the final model clears the same vienna/berlin
     quality gates the CI smoke jobs use;
-  * restarts and recovery latency land in ``FAULT_BENCH.json`` (repo
-    root), comparable across PRs.
+  * everything lands in ``FAULT_BENCH.json`` (repo root), comparable
+    across PRs.
 
 Env: GLINT_CHAOS_WORKERS (gang size, default 2; 1 = supervised
 single-process fit), GLINT_CHAOS_ITERATIONS (default 6),
@@ -25,12 +36,15 @@ fails.
 import json
 import math
 import os
+import subprocess
 import sys
 import time
+import urllib.request
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.join(ROOT, "tests"))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -82,17 +96,64 @@ def _groups_per_epoch(sentences, workers: int) -> int:
     return max(1, math.ceil(steps / SPC))
 
 
+def _fetch(url: str, timeout: float = 2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _scrape_merged(port: int, workers: int, proc) -> dict:
+    """Poll the supervisor's merged endpoint while the gang trains;
+    keep the best sample (all ranks reporting) plus one lint-checked
+    Prometheus scrape. Never fails the drill by itself — missing
+    samples turn into failed checks downstream."""
+    from glint_word2vec_tpu.obs.prometheus import lint_prometheus_text
+
+    best, prom_ok, healthz_seen = None, False, False
+    while proc.poll() is None:
+        try:
+            merged = json.loads(
+                _fetch(f"http://127.0.0.1:{port}/metrics")
+            )
+        except Exception:
+            time.sleep(0.25)
+            continue
+        if merged.get("ranks_reporting"):
+            if best is None or (
+                merged["ranks_reporting"]
+                >= best.get("ranks_reporting", 0)
+            ):
+                best = merged
+        if not healthz_seen:
+            try:
+                _fetch(f"http://127.0.0.1:{port}/healthz")
+                healthz_seen = True
+            except Exception:
+                pass
+        if not prom_ok and merged.get("ranks_reporting") == workers:
+            try:
+                lint_prometheus_text(_fetch(
+                    f"http://127.0.0.1:{port}/metrics?format=prometheus"
+                ))
+                prom_ok = True
+            except Exception as e:
+                print(f"prometheus scrape failed lint: {e}",
+                      file=sys.stderr)
+        time.sleep(0.25)
+    return {"sample": best, "prometheus_lint_ok": prom_ok,
+            "healthz_ok": healthz_seen}
+
+
 def main() -> int:
     workers = int(os.environ.get("GLINT_CHAOS_WORKERS", 2))
     iterations = int(os.environ.get("GLINT_CHAOS_ITERATIONS", 6))
     import tempfile
 
-    from glint_word2vec_tpu.parallel.supervisor import Supervisor
-
     tmp = tempfile.mkdtemp(prefix="chaos_drill_")
     corpus = os.path.join(tmp, "capitals.txt")
     model_dir = os.path.join(tmp, "model")
     ck_dir = os.path.join(tmp, "ck")
+    sup_dir = os.path.join(tmp, "supervisor")
+    report_path = os.path.join(tmp, "report.json")
     sentences = _make_tiny_corpus()
     with open(corpus, "w") as f:
         for s in sentences:
@@ -104,8 +165,11 @@ def main() -> int:
     # before any epoch-2 group dispatches); one epoch later for the
     # single-process async-checkpoint path, giving the background
     # writer a whole epoch of margin to commit.
+    from glint_word2vec_tpu.parallel.supervisor import free_port
+
     kill_at = (gpe if workers > 1 else 2 * gpe) + 2
     fault = f"worker.step:kill@{kill_at}"
+    metrics_port = free_port()
 
     train_rest = [
         "--corpus", corpus, "--output", model_dir,
@@ -121,33 +185,44 @@ def main() -> int:
             "--num-partitions", str(workers), "--num-shards", "1",
         ]
 
-    from glint_word2vec_tpu.parallel.supervisor import (
-        cli_train_build_argv,
-    )
-
-    build_argv = cli_train_build_argv(train_rest)
+    # The REAL operator entry point: cli supervise persists the report
+    # (--report-out) and serves the merged gang endpoint; this script
+    # consumes both instead of re-deriving anything in-process.
+    argv = [
+        sys.executable, "-m", "glint_word2vec_tpu.cli", "supervise",
+        "--workers", str(workers),
+        "--max-restarts", "3",
+        "--backoff-base", "0.5", "--backoff-cap", "5",
+        "--heartbeat-stale", "300", "--startup-grace", "600",
+        "--supervise-dir", sup_dir,
+        "--report-out", report_path,
+        "--metrics-port", str(metrics_port),
+        # Armed for rank 0's FIRST launch only — a re-armed relaunch
+        # would die at the same group forever.
+        "--rank0-env", f"GLINT_FAULTS={fault}",
+        "train", *train_rest,
+    ]
 
     print(
         f"chaos drill: {workers} worker(s), {gpe} groups/epoch, "
-        f"armed {fault!r} on rank 0 generation 0",
+        f"armed {fault!r} on rank 0 generation 0; merged metrics on "
+        f"port {metrics_port}",
         flush=True,
     )
     t0 = time.time()
-    report = Supervisor(
-        build_argv,
-        workers,
-        status_dir=os.path.join(tmp, "supervisor"),
-        checkpoint_dir=ck_dir,
-        # The kill schedule arms ONLY generation 0 of rank 0 — a
-        # re-armed relaunch would die at the same group forever.
-        rank_env_first_launch={0: {"GLINT_FAULTS": fault}},
-        heartbeat_stale_seconds=300.0,
-        startup_grace_seconds=600.0,
-        max_restarts=3,
-        backoff_base_seconds=0.5,
-        backoff_cap_seconds=5.0,
-    ).run()
+    sup_log = os.path.join(tmp, "supervise.log")
+    with open(sup_log, "wb") as logf:
+        proc = subprocess.Popen(argv, stdout=logf,
+                                stderr=subprocess.STDOUT)
+        gang = _scrape_merged(metrics_port, workers, proc)
+        rc = proc.wait()
     wall = time.time() - t0
+    with open(sup_log, "rb") as f:
+        print(f.read()[-4000:].decode(errors="replace"), flush=True)
+
+    report = None
+    if os.path.exists(report_path):
+        report = json.load(open(report_path))
 
     out = {
         "metric": "chaos_drill",
@@ -156,19 +231,89 @@ def main() -> int:
         "groups_per_epoch": gpe,
         "fault": fault,
         "wall_seconds": round(wall, 2),
-        "supervisor": report.to_dict(),
+        "supervise_rc": rc,
+        "supervisor": report,
     }
 
     checks = {
-        "completed": report.completed,
-        "restarts_exactly_one": report.restarts == 1,
+        "report_written": report is not None,
+        "completed": bool(report and report["completed"]),
+        "restarts_exactly_one": bool(report and report["restarts"] == 1),
         "resumed_from_committed_checkpoint": bool(
-            report.restart_records
-            and report.restart_records[0].resumed_from
+            report
+            and report["restart_records"]
+            and report["restart_records"][0]["resumed_from"]
         ),
+        "merged_healthz_answered": gang["healthz_ok"],
+        "merged_prometheus_lints": gang["prometheus_lint_ok"],
     }
+
+    # -- merged gang endpoint: counters are sums, rank_skew present ----
+    sample = gang["sample"]
+    out["gang_metrics"] = sample
+    merged_ok = sums_ok = skew_present = False
+    if sample:
+        merged_ok = sample.get("ranks_reporting", 0) >= 1
+        per_rank = sample.get("per_rank") or {}
+        counters = sample.get("counters") or {}
+        sums_ok = (
+            counters.get("steps_total")
+            == sum(r.get("step") or 0 for r in per_rank.values())
+            and counters.get("words_done_total")
+            == sum(r.get("words_done") or 0 for r in per_rank.values())
+        )
+        # Not just key presence (the merge always emits the key): a
+        # full-gang sample must carry a REAL skew number, or the
+        # straggler gauge silently died (e.g. step_time vanished from
+        # the heartbeat snapshot).
+        skew = sample.get("rank_skew")
+        skew_present = (
+            isinstance(skew, (int, float)) and skew >= 1.0
+            if sample.get("ranks_reporting") == workers
+            else skew is not None
+        )
+    checks["merged_metrics_scraped"] = merged_ok
+    checks["merged_counters_equal_rank_sums"] = sums_ok
+    checks["rank_skew_present"] = skew_present
+
+    # -- crash flight recorder: the killed rank's bundle ---------------
+    bundle_ok = False
+    if report and report["restart_records"]:
+        bundles = report["restart_records"][0].get("postmortem") or []
+        rank0 = [b for b in bundles if b.endswith("-0")]
+        if rank0 and os.path.isdir(rank0[0]):
+            files = set(os.listdir(rank0[0]))
+            bundle_ok = {"heartbeat.json", "events.jsonl",
+                         "meta.json"} <= files
+            out["postmortem_bundle"] = {
+                "path": rank0[0], "files": sorted(files),
+            }
+    checks["postmortem_bundle_collected"] = bundle_ok
+
+    # -- rank-laned merged Chrome trace --------------------------------
+    from trace_summarize import merge_rank_traces
+
+    event_logs = [
+        os.path.join(sup_dir, f"events-{r}.jsonl")
+        for r in range(workers)
+    ]
+    trace_lanes_ok = False
+    if all(os.path.exists(p) for p in event_logs):
+        doc = merge_rank_traces(event_logs)
+        lanes = {
+            ev["pid"] for ev in doc["traceEvents"]
+            if ev.get("ph") != "M"
+        }
+        trace_lanes_ok = len(lanes) == workers
+        out["merged_trace"] = {
+            "ranks": doc["otherData"]["ranks"],
+            "events": len(doc["traceEvents"]),
+            "lanes": sorted(lanes),
+        }
+    checks["merged_trace_one_lane_per_rank"] = trace_lanes_ok
+
     quality = {}
-    if report.completed:
+    if checks["completed"]:
         from glint_word2vec_tpu.utils.platform import force_platform
 
         force_platform()

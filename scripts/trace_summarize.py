@@ -10,12 +10,23 @@ aggregates device-plane event durations by HLO class.
 
 Usage: python scripts/trace_summarize.py --trace DIR [--out FILE]
                                          [--host-spans EVENTS.jsonl]
+       python scripts/trace_summarize.py --merge-ranks E0.jsonl E1.jsonl ...
+                                         [--out MERGED.json]
 Writes one JSON doc (``schema_version`` stamped): per-device-plane total
 busy time and the per-class µs + share table, classified from the
 op/fusion names XLA emits. ``--host-spans`` merges the obs span event
 log (the JSONL the fit writes with ``--event-log``) as a per-span-name
 host-side table, so host phases (host batching, device dispatch windows,
 compaction, checkpoints) read side by side with the device op classes.
+
+``--merge-ranks`` (ISSUE 8 flight recorder) instead merges per-rank obs
+event JSONLs (the ``events-<rank>.jsonl`` files a supervised gang
+writes, or any ``--event-log`` outputs) into ONE rank-laned Chrome
+trace: each rank becomes its own process lane (pid = rank, named
+"rank N"), and per-file clock anchors (the ``clock_anchor`` metadata
+line each recorder emits) rebase every rank's monotonic timestamps onto
+a shared wall-clock timeline, so cross-rank skew reads directly off the
+lanes in chrome://tracing / Perfetto.
 """
 
 import argparse
@@ -167,7 +178,12 @@ def summarize_host_spans(jsonl_path: str) -> dict:
             line = line.strip()
             if not line:
                 continue
-            ev = json.loads(line)
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a killed worker's sink
+            if ev.get("ph") == "M":
+                continue  # metadata (clock anchors): no span/instant
             if ev.get("ph") == "X":
                 by_tid[ev.get("tid", 0)].append(
                     (ev.get("ts", 0.0), ev.get("dur", 0.0), ev["name"])
@@ -194,9 +210,85 @@ def summarize_host_spans(jsonl_path: str) -> dict:
     }
 
 
+def _rank_of(path: str, index: int) -> int:
+    """Rank for one per-rank events file: the ``events-<rank>`` file
+    naming the supervisor uses wins; anything else falls back to the
+    argument position."""
+    m = re.search(r"events-(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else index
+
+
+def merge_rank_traces(paths) -> dict:
+    """Merge per-rank obs event JSONLs into one rank-laned Chrome trace
+    document. Each input file becomes one process lane (pid = rank,
+    process_name "rank N"); timestamps are rebased via each file's
+    clock-anchor line onto the earliest rank's wall clock so the lanes
+    share a timeline (files from recorders without an anchor — pre-
+    ISSUE-8 logs — keep their own zero, flagged in otherData)."""
+    ranks = []
+    truncated = 0
+    for i, path in enumerate(paths):
+        rank, anchor, events = _rank_of(path, i), None, []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    # A SIGKILLed worker's sink is routinely cut mid-
+                    # line — exactly the input this tool exists for.
+                    # Skip (and count) the torn tail, keep the trace.
+                    truncated += 1
+                    continue
+                if ev.get("ph") == "M":
+                    if ev.get("name") == "clock_anchor":
+                        anchor = float(
+                            (ev.get("args") or {}).get("wall_t0", 0.0)
+                        )
+                    continue
+                events.append(ev)
+        ranks.append({"rank": rank, "path": path, "anchor": anchor,
+                      "events": events})
+    anchors = [r["anchor"] for r in ranks if r["anchor"] is not None]
+    t0 = min(anchors) if anchors else 0.0
+    trace_events, unanchored = [], []
+    for r in sorted(ranks, key=lambda r: r["rank"]):
+        trace_events.append({
+            "name": "process_name", "ph": "M", "pid": r["rank"],
+            "args": {"name": f"rank {r['rank']}"},
+        })
+        shift_us = (
+            (r["anchor"] - t0) * 1e6 if r["anchor"] is not None else 0.0
+        )
+        if r["anchor"] is None:
+            unanchored.append(r["path"])
+        for ev in r["events"]:
+            ev = dict(ev)
+            ev["pid"] = r["rank"]
+            ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
+            trace_events.append(ev)
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "ranks": sorted(r["rank"] for r in ranks),
+            "wall_t0": t0,
+            "unanchored_files": unanchored,
+            "truncated_lines": truncated,
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default="/tmp/glint_trace_r05")
+    ap.add_argument("--merge-ranks", nargs="+", default=None,
+                    metavar="EVENTS_JSONL",
+                    help="merge per-rank obs event JSONLs into one "
+                         "rank-laned Chrome trace instead of "
+                         "summarizing an xplane trace")
     ap.add_argument("--steps", type=int, default=0,
                     help="steps inside the trace, for us/step derivation")
     ap.add_argument("--host-spans", default=None,
@@ -204,6 +296,25 @@ def main(argv=None) -> int:
                          "per-span table next to the device classes")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.merge_ranks:
+        missing = [p for p in args.merge_ranks if not os.path.exists(p)]
+        if missing:
+            print(
+                f"error: missing event log(s): {', '.join(missing)}",
+                file=sys.stderr,
+            )
+            return 2
+        doc = merge_rank_traces(args.merge_ranks)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f)
+        print(json.dumps({
+            "merged": len(args.merge_ranks),
+            "ranks": doc["otherData"]["ranks"],
+            "events": len(doc["traceEvents"]),
+            "out": args.out,
+        }))
+        return 0
     paths = find_xplane_files(args.trace)
     if not paths:
         print(

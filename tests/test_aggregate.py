@@ -1,0 +1,368 @@
+"""Fleet-observability tests (ISSUE 8, obs/aggregate.py): the gang
+merge's sum/skew/generation semantics, serving-replica snapshot merging
+(bucket-exact histogram combination), the merged HTTP endpoint, and the
+lint-cleanliness of the full gang + serving Prometheus exposition."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.obs.aggregate import (
+    GangStatusServer,
+    merge_serving_snapshots,
+    merge_training_snapshots,
+)
+from glint_word2vec_tpu.obs.prometheus import (
+    gang_to_prometheus,
+    lint_prometheus_text,
+    serving_to_prometheus,
+    training_to_prometheus,
+)
+from glint_word2vec_tpu.utils.metrics import (
+    LatencyHistogram,
+    ServingMetrics,
+    StepTimeLedger,
+)
+
+
+def _rank_snap(gen=1, step=10, words=100, wps=5.0, step_time=1.0,
+               state="running", ledger=None, **extra):
+    snap = {
+        "state": state, "supervisor_generation": gen, "step": step,
+        "words_done": words, "words_per_sec_rolling": wps,
+        "step_time": step_time, "epoch": 1, "host_frac": 0.1,
+        "query_compiles": 2, "async_save_waits": 1,
+        "canary": {"mode": "off", "trips": 3, "last_reason": None},
+        "events": {"recorded": 7, "dropped": 2, "capacity": 64},
+    }
+    if ledger is not None:
+        snap["steptime"] = ledger.snapshot()
+    snap.update(extra)
+    return snap
+
+
+# ----------------------------------------------------------------------
+# merge_training_snapshots
+# ----------------------------------------------------------------------
+
+
+def test_merged_counters_equal_sum_of_per_rank_values():
+    # The acceptance contract: every merged counter is the sum of the
+    # per-rank values it was built from.
+    snaps = {
+        0: _rank_snap(step=10, words=100),
+        1: _rank_snap(step=25, words=450),
+        2: _rank_snap(step=5, words=50),
+    }
+    m = merge_training_snapshots(snaps, generation=1, num_workers=3)
+    assert m["ranks_reporting"] == 3
+    c = m["counters"]
+    assert c["steps_total"] == sum(
+        r["step"] for r in m["per_rank"].values()
+    ) == 40
+    assert c["words_done_total"] == sum(
+        r["words_done"] for r in m["per_rank"].values()
+    ) == 600
+    assert c["query_compiles_total"] == 6
+    assert c["async_save_waits_total"] == 3
+    assert c["canary_trips_total"] == 9
+    assert c["events_recorded_total"] == 21
+    assert c["events_dropped_total"] == 6
+    assert m["words_per_sec_total"] == 15.0
+    assert m["state"] == "running"
+
+
+def test_rank_skew_is_max_over_median_mean_step_time():
+    # rank 0: 1.0s/10 steps = 0.1 s/step; rank 1: 0.05; rank 2: 0.1
+    # -> median 0.1, max 0.1 ... make rank 1 the straggler instead.
+    snaps = {
+        0: _rank_snap(step=10, step_time=1.0),
+        1: _rank_snap(step=10, step_time=3.0),   # 0.3 s/step straggler
+        2: _rank_snap(step=10, step_time=1.0),
+    }
+    m = merge_training_snapshots(snaps, generation=1)
+    assert m["rank_skew"] == pytest.approx(0.3 / 0.1)
+    # Balanced gang -> 1.0; no step timing anywhere -> None (NaN in the
+    # exposition, key still present).
+    bal = merge_training_snapshots(
+        {0: _rank_snap(), 1: _rank_snap()}, generation=1
+    )
+    assert bal["rank_skew"] == 1.0
+    none = merge_training_snapshots(
+        {0: {"state": "running", "supervisor_generation": 1}},
+        generation=1,
+    )
+    assert none["rank_skew"] is None and "rank_skew" in none
+
+
+def test_generation_stamping_drops_pre_restart_snapshots():
+    # A stale pre-restart status file must never pollute the merged
+    # view: its counters vanish, the merged doc is stamped with the
+    # CURRENT generation.
+    snaps = {
+        0: _rank_snap(gen=2, step=10),
+        1: _rank_snap(gen=1, step=999999),  # pre-restart leftover
+        2: None,                            # no heartbeat yet
+    }
+    m = merge_training_snapshots(snaps, generation=2, num_workers=3)
+    assert m["generation"] == 2
+    assert m["ranks_reporting"] == 1
+    assert m["counters"]["steps_total"] == 10
+    assert list(m["per_rank"]) == ["0"]
+
+
+def test_gang_state_aggregation():
+    mk = lambda s: _rank_snap(state=s)  # noqa: E731
+    g = lambda snaps: merge_training_snapshots(  # noqa: E731
+        snaps, generation=1
+    )["state"]
+    assert g({}) == "starting"
+    assert g({0: mk("running"), 1: mk("done")}) == "running"
+    assert g({0: mk("done"), 1: mk("done")}) == "done"
+    assert g({0: mk("running"), 1: mk("diverged")}) == "diverged"
+    assert g({0: mk("failed"), 1: mk("running")}) == "failed"
+
+
+def test_steptime_merges_across_ranks_with_exact_histograms():
+    led0, led1 = StepTimeLedger(), StepTimeLedger()
+    for d in (0.01, 0.02, 0.04):
+        led0.account("dispatch", d)
+    for d in (0.08, 0.16):
+        led1.account("dispatch", d)
+    led1.account("checkpoint", 0.5)
+    m = merge_training_snapshots(
+        {0: _rank_snap(ledger=led0), 1: _rank_snap(ledger=led1)},
+        generation=1,
+    )
+    st = m["steptime"]
+    assert st["dispatch"]["count"] == 5
+    assert st["checkpoint"]["seconds"] == pytest.approx(0.5, abs=1e-3)
+    # Merged quantiles equal the whole-population histogram's.
+    whole = LatencyHistogram()
+    for d in (0.01, 0.02, 0.04, 0.08, 0.16):
+        whole.record(d)
+    assert st["dispatch"]["p50_ms"] == round(
+        whole.quantile(0.5) * 1e3, 3
+    )
+    assert st["dispatch"]["p99_ms"] == round(
+        whole.quantile(0.99) * 1e3, 3
+    )
+
+
+# ----------------------------------------------------------------------
+# merge_serving_snapshots
+# ----------------------------------------------------------------------
+
+
+def _serving_snapshot(latencies, path="/synonyms", errors=0, **obs):
+    sm = ServingMetrics()
+    for i, lat in enumerate(latencies):
+        sm.observe(path, lat, status=500 if i < errors else 200)
+    for k, v in obs.items():
+        setattr(sm, k, v)
+    return sm.snapshot(total_compiles=1)
+
+
+def test_serving_merge_is_bucket_exact_and_renderable():
+    rng = np.random.default_rng(9)
+    lat_a = rng.lognormal(-6, 1.0, 400)
+    lat_b = rng.lognormal(-4, 0.5, 400)
+    a = _serving_snapshot(list(lat_a), errors=3)
+    b = _serving_snapshot(list(lat_b))
+    # JSON round trip: replicas arrive over HTTP as parsed JSON.
+    merged = merge_serving_snapshots(
+        [json.loads(json.dumps(a)), json.loads(json.dumps(b))]
+    )
+    ep = merged["endpoints"]["/synonyms"]
+    assert ep["count"] == 800 and ep["errors"] == 3
+    whole = LatencyHistogram()
+    for x in np.concatenate([lat_a, lat_b]):
+        whole.record(float(x))
+    assert ep["p95_ms"] == round(whole.quantile(0.95) * 1e3, 3)
+    assert merged["replicas"] == 2
+    assert merged["compiles"]["total"] == 2
+    # The merged doc has the exact ServingMetrics.snapshot shape: the
+    # UNCHANGED serving renderer serves the fleet, lint-clean.
+    lint_prometheus_text(serving_to_prometheus(merged))
+    assert merge_serving_snapshots([]) is None
+
+
+def test_serving_merge_mixed_fleet_keeps_slowest_replica_quantiles():
+    # A legacy (hist-less) replica degrades the merge to max-fold mode —
+    # which must still cover the hist-CARRYING replicas, or a slow
+    # modern replica's p99 silently vanishes behind a fast legacy peer.
+    slow = _serving_snapshot([0.5, 0.6, 0.7])          # carries hist
+    fast = _serving_snapshot([0.001])
+    for k in list(fast["endpoints"]["/synonyms"]):
+        if k == "hist":
+            del fast["endpoints"]["/synonyms"][k]       # legacy replica
+    m = merge_serving_snapshots([fast, slow])
+    ep = m["endpoints"]["/synonyms"]
+    assert ep["approx"] is True
+    assert ep["p99_ms"] >= 500.0, ep  # the slow replica's p99 survives
+    lint_prometheus_text(serving_to_prometheus(m))
+
+
+def test_serving_merge_sums_counters_peaks_and_checkpoint_worst():
+    a = _serving_snapshot([0.01], cache_hits=5, shed_admission=2,
+                          inflight_peak=3)
+    b = _serving_snapshot([0.01], cache_hits=7, shed_admission=1,
+                          inflight_peak=9)
+    a["checkpoint"] = {"pending_async_saves": 1,
+                       "last_checkpoint_age_seconds": 10.0,
+                       "checkpoint_write_seconds": 0.5}
+    b["checkpoint"] = {"pending_async_saves": 0,
+                       "last_checkpoint_age_seconds": 90.0,
+                       "checkpoint_write_seconds": None}
+    m = merge_serving_snapshots([a, b])
+    assert m["synonym_cache"]["hits"] == 12
+    assert m["overload"]["shed_admission_total"] == 3
+    assert m["overload"]["inflight_peak"] == 9  # peak, not sum
+    assert m["checkpoint"]["pending_async_saves"] == 1
+    assert m["checkpoint"]["last_checkpoint_age_seconds"] == 90.0
+    assert m["checkpoint"]["checkpoint_write_seconds"] == 0.5
+
+
+# ----------------------------------------------------------------------
+# Prometheus exposition (satellite: full gang + serving render lints)
+# ----------------------------------------------------------------------
+
+
+def test_full_gang_plus_serving_exposition_lints_clean():
+    # The whole merged surface through BOTH renderers, concatenated the
+    # way GangStatusServer serves it: new aggregate keys cannot silently
+    # break the exposition.
+    led = StepTimeLedger()
+    for d in (0.01, 0.2):
+        led.account("dispatch", d)
+    led.account("producer_wait", 0.05)
+    merged = merge_training_snapshots(
+        {0: _rank_snap(ledger=led), 1: _rank_snap(step=0, wps=0.0),
+         2: None},
+        generation=3, num_workers=3,
+    )
+    serving = merge_serving_snapshots([
+        _serving_snapshot([0.001, 0.02], errors=1),
+        _serving_snapshot([0.5], path="/transform"),
+    ])
+    text = gang_to_prometheus(merged) + serving_to_prometheus(serving)
+    lint_prometheus_text(text)
+    assert "glint_gang_rank_skew" in text
+    assert 'glint_gang_steptime_seconds{phase="dispatch"}' in text
+    assert "glint_serving_requests_total" in text
+
+
+def test_training_exposition_with_steptime_lints_clean():
+    led = StepTimeLedger()
+    led.account("dispatch", 0.1)
+    led.finalize()
+    snap = {
+        "state": "done", "pipeline": "device_corpus", "epoch": 2,
+        "canary": {"mode": "off", "trips": 0, "last_reason": None},
+        "steptime": led.snapshot(),
+    }
+    text = training_to_prometheus(snap)
+    lint_prometheus_text(text)
+    assert 'glint_training_steptime_seconds{phase="checkpoint"}' in text
+    assert 'glint_training_steptime_ops_total{phase="dispatch"} 1' in text
+
+
+# ----------------------------------------------------------------------
+# GangStatusServer HTTP surface
+# ----------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_gang_server_serves_merged_json_prometheus_and_healthz():
+    srv = GangStatusServer(port=0, num_workers=2)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        srv.update(0, {0: _rank_snap(gen=0, step=4, words=40),
+                       1: _rank_snap(gen=0, step=6, words=60)})
+        h = json.loads(_get(base + "/healthz"))
+        assert h["status"] == "ok" and h["ranks_reporting"] == 2
+        m = json.loads(_get(base + "/metrics"))
+        assert m["generation"] == 0
+        assert m["counters"]["steps_total"] == 10
+        assert m["counters"]["words_done_total"] == 100
+        assert "rank_skew" in m
+        lint_prometheus_text(_get(base + "/metrics?format=prometheus"))
+        # A restart: the view flips to the new generation and the old
+        # snapshots (now stale) are excluded by the stamp.
+        srv.update(1, {0: _rank_snap(gen=0, step=999), 1: None})
+        m = json.loads(_get(base + "/metrics"))
+        assert m["generation"] == 1 and m["ranks_reporting"] == 0
+        # Unknown route -> 404.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/nope")
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_gang_server_healthz_503_on_bad_rank():
+    srv = GangStatusServer(port=0, num_workers=2)
+    srv.start()
+    try:
+        srv.update(0, {0: _rank_snap(gen=0),
+                       1: _rank_snap(gen=0, state="diverged")})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert e.value.code == 503
+        body = json.loads(e.value.read().decode())
+        assert body["state"] == "diverged"
+    finally:
+        srv.stop()
+
+
+def test_gang_server_joins_serving_replicas_lazily(tmp_path):
+    # Two fake serving replicas: one answers with a real snapshot, one
+    # is a dead URL — the merged view must carry the live one and
+    # report (not die on) the dead one.
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    snap = _serving_snapshot([0.001, 0.002])
+
+    class Replica(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(snap).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    rep = ThreadingHTTPServer(("127.0.0.1", 0), Replica)
+    threading.Thread(target=rep.serve_forever, daemon=True).start()
+    live = f"http://127.0.0.1:{rep.server_address[1]}/metrics"
+    dead = "http://127.0.0.1:1/metrics"
+    srv = GangStatusServer(port=0, num_workers=1,
+                           serving_urls=[live, dead])
+    srv.start()
+    try:
+        srv.update(0, {0: _rank_snap(gen=0)})
+        m = json.loads(_get(f"http://127.0.0.1:{srv.port}/metrics"))
+        assert m["serving"]["replicas"] == 1
+        assert m["serving"]["endpoints"]["/synonyms"]["count"] == 2
+        assert m["serving_sources"][live] == "ok"
+        assert m["serving_sources"][dead].startswith("error")
+        text = _get(
+            f"http://127.0.0.1:{srv.port}/metrics?format=prometheus"
+        )
+        lint_prometheus_text(text)
+        assert "glint_serving_requests_total" in text
+    finally:
+        srv.stop()
+        rep.shutdown()
+        rep.server_close()

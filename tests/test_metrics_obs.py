@@ -1,5 +1,7 @@
 """Satellite coverage (ISSUE 3): LatencyHistogram quantile edge cases,
-the bounded TrainingMetrics history, and atomic metric dumps."""
+the bounded TrainingMetrics history, and atomic metric dumps; (ISSUE 8):
+LatencyHistogram.merge property tests — merged-parts quantiles must
+equal whole-population truth — and state round-trips."""
 
 import json
 import os
@@ -82,6 +84,82 @@ def test_quantiles_monotone_in_q_exhaustively():
     qs = np.linspace(0.01, 1.0, 50)
     vals = [h.quantile(float(q)) for q in qs]
     assert all(a <= b + 1e-12 for a, b in zip(vals, vals[1:]))
+
+
+# ----------------------------------------------------------------------
+# LatencyHistogram.merge (ISSUE 8): the gang aggregator's primitive
+# ----------------------------------------------------------------------
+
+
+def _hist_of(samples):
+    h = LatencyHistogram()
+    for s in samples:
+        h.record(float(s))
+    return h
+
+
+def test_merge_of_parts_equals_whole_population():
+    # Property: recording a population split across K rank-local
+    # histograms and merging them must equal recording the whole
+    # population into one histogram — same counts, same total/max,
+    # and BIT-IDENTICAL quantiles at every q (bucket merges are exact).
+    rng = np.random.default_rng(3)
+    for dist, k in (("lognormal", 2), ("lognormal", 7),
+                    ("uniform", 4), ("bimodal", 3)):
+        if dist == "lognormal":
+            samples = rng.lognormal(-6.0, 1.5, 3000)
+        elif dist == "uniform":
+            samples = rng.uniform(1e-4, 5e-2, 3000)
+        else:
+            samples = np.concatenate([
+                rng.uniform(2e-4, 4e-4, 1500),
+                rng.uniform(2e-2, 4e-2, 1500),
+            ])
+        parts = [_hist_of(p) for p in np.array_split(samples, k)]
+        merged = LatencyHistogram.merge(parts)
+        whole = _hist_of(samples)
+        assert merged.counts == whole.counts
+        assert merged.n == whole.n
+        assert abs(merged.total - whole.total) < 1e-9
+        assert merged.max == whole.max
+        for q in np.linspace(0.01, 1.0, 23):
+            assert merged.quantile(float(q)) == whole.quantile(float(q))
+
+
+def test_merge_empty_and_single_rank_edges():
+    # No parts / all-empty parts -> an empty histogram that quantiles 0.
+    assert LatencyHistogram.merge([]).n == 0
+    empty = LatencyHistogram.merge([LatencyHistogram(),
+                                    LatencyHistogram()])
+    assert empty.n == 0 and empty.quantile(0.99) == 0.0
+    # A single rank merges to itself (empty peers are no-ops).
+    h = _hist_of([0.001, 0.002, 0.004])
+    merged = LatencyHistogram.merge([h, LatencyHistogram()])
+    assert merged.counts == h.counts and merged.n == h.n
+    for q in (0.25, 0.5, 0.95):
+        assert merged.quantile(q) == h.quantile(q)
+
+
+def test_merge_accepts_state_dicts_and_round_trips_json(tmp_path):
+    # The aggregator receives histograms as JSON state (status files /
+    # serving snapshots cross a process boundary): state() -> JSON ->
+    # from_state/merge must lose nothing.
+    rng = np.random.default_rng(5)
+    a = _hist_of(rng.lognormal(-7, 1.0, 800))
+    b = _hist_of(rng.uniform(1e-3, 1e-1, 800))
+    via_state = LatencyHistogram.merge([
+        json.loads(json.dumps(a.state())),
+        json.loads(json.dumps(b.state())),
+    ])
+    direct = LatencyHistogram.merge([a, b])
+    assert via_state.counts == direct.counts
+    assert via_state.n == direct.n and via_state.max == direct.max
+    for q in (0.5, 0.95, 0.99):
+        assert via_state.quantile(q) == direct.quantile(q)
+    # Round trip of a single histogram reproduces it exactly.
+    rt = LatencyHistogram.from_state(a.state())
+    assert rt.counts == a.counts and rt.n == a.n
+    assert rt.total == a.total and rt.max == a.max
 
 
 # ----------------------------------------------------------------------

@@ -43,8 +43,13 @@ def test_recorder_spans_events_and_ring_bound(tmp_path):
     counts = rec.counts()
     assert counts == {"recorded": 10, "dropped": 6, "capacity": 4}
 
-    # The JSONL sink received EVERY event (it is not ring-bounded).
-    lines = [json.loads(line) for line in open(log) if line.strip()]
+    # The JSONL sink received EVERY event (it is not ring-bounded),
+    # prefixed by the clock-anchor metadata line --merge-ranks aligns
+    # rank timelines with (a metadata "M" record, not an event).
+    raw = [json.loads(line) for line in open(log) if line.strip()]
+    assert raw[0]["name"] == "clock_anchor" and raw[0]["ph"] == "M"
+    assert raw[0]["args"]["wall_t0"] == rec.wall_t0
+    lines = [e for e in raw if e["ph"] != "M"]
     assert len(lines) == 10
     span = next(e for e in lines if e["name"] == "outer")
     assert span["ph"] == "X" and span["dur"] >= 2000  # µs

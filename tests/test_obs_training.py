@@ -235,6 +235,67 @@ def test_canary_abort_on_device_corpus_path(tiny_corpus, monkeypatch):
         w2v.fit(_small(tiny_corpus))
 
 
+def test_steptime_ledger_attributes_fit_wall_time(tiny_corpus, tmp_path):
+    # ISSUE 8 acceptance: STEPTIME.json phase totals sum to within 5%
+    # of the measured fit wall time, the breakdown reaches the status
+    # file / training_metrics, and the attribution is real (the span
+    # gap folded into "other" stays a small share of the run).
+    steptime = str(tmp_path / "STEPTIME.json")
+    status_file = str(tmp_path / "status.json")
+    obs = ObsConfig(steptime_path=steptime, status_file=status_file,
+                    status_interval=0.0)
+    model = Word2Vec(
+        mesh=make_mesh(1, 2), obs=obs, vector_size=16, min_count=5,
+        batch_size=128, seed=3, num_iterations=2,
+    ).fit(_small(tiny_corpus))
+
+    doc = json.loads(open(steptime).read())
+    assert doc["schema_version"] == 1
+    phases = doc["phases"]
+    from glint_word2vec_tpu.utils.metrics import LEDGER_PHASES
+
+    assert set(phases) == set(LEDGER_PHASES)
+    total = sum(p["seconds"] for p in phases.values())
+    # Phase totals are a decomposition of the ledger's wall clock...
+    assert total == pytest.approx(doc["wall_seconds"], rel=0.05)
+    # ...and the ledger's wall clock IS the fit's (both wrap the same
+    # loop; construction-order skew only).
+    fit_wall = model.training_metrics["wall_seconds"]
+    assert total == pytest.approx(fit_wall, rel=0.05, abs=0.75)
+    # The attribution is real: the device dispatch phase was exercised
+    # and the unattributed gap is a minor share of the run.
+    assert phases["dispatch"]["seconds"] > 0
+    assert phases["dispatch"]["count"] > 0
+    assert phases["dispatch"]["p50_ms"] > 0
+    assert doc["unattributed_seconds"] <= 0.5 * doc["wall_seconds"]
+
+    # Same breakdown on the heartbeat snapshot (with histogram state
+    # for the gang aggregator) and in training_metrics.
+    status = json.loads(open(status_file).read())
+    st = status["steptime"]
+    assert st["phases"]["dispatch"]["count"] == phases["dispatch"]["count"]
+    assert st["phases"]["dispatch"]["hist"]["n"] > 0
+    tm = model.training_metrics["steptime"]
+    assert set(tm) == set(LEDGER_PHASES)
+    assert tm["dispatch"] > 0
+    model.stop()
+
+
+def test_steptime_ledger_costs_nothing_when_obs_off(tiny_corpus):
+    # The satellite bound: with obs off the fit loops' span hooks stay
+    # on the NULL_SPAN path — no ledger exists, no steptime key appears.
+    model = Word2Vec(
+        mesh=make_mesh(1, 2), vector_size=16, min_count=5,
+        batch_size=128, seed=3, num_iterations=1,
+    ).fit(_small(tiny_corpus))
+    assert "steptime" not in model.training_metrics
+    from glint_word2vec_tpu.obs import NULL_RUN
+
+    assert NULL_RUN.steptime_totals() is None
+    assert NULL_RUN.span("device_steps") is obs_events.NULL_SPAN
+    model.stop()
+
+
 @pytest.mark.slow
 def test_event_recorder_overhead_within_3_percent(tiny_corpus, tmp_path):
     # ISSUE 3 overhead guard, bench-style. An end-to-end A/B of two fits

@@ -81,6 +81,104 @@ def test_host_span_merge_charges_nested_time_to_innermost(tmp_path):
     assert doc["host_busy_us"] == 1050.0
 
 
+def _rank_log(tmp_path, rank, wall_t0, spans):
+    p = tmp_path / f"events-{rank}.jsonl"
+    lines = [json.dumps({"name": "clock_anchor", "ph": "M", "ts": 0,
+                         "pid": 1000 + rank,
+                         "args": {"wall_t0": wall_t0}})]
+    for name, ts, dur in spans:
+        lines.append(json.dumps({"name": name, "ph": "X", "ts": ts,
+                                 "dur": dur, "pid": 1000 + rank,
+                                 "tid": 7}))
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_merge_ranks_one_lane_per_rank_on_shared_timeline(tmp_path):
+    # ISSUE 8: per-rank event JSONLs merge into ONE Chrome trace with a
+    # process lane per rank, clock-anchored onto a shared timeline —
+    # rank 1 started 2s after rank 0, so its spans shift by +2e6 µs.
+    f0 = _rank_log(tmp_path, 0, 100.0,
+                   [("device_steps", 10.0, 5.0), ("host_batch", 20.0, 1.0)])
+    f1 = _rank_log(tmp_path, 1, 102.0, [("device_steps", 10.0, 5.0)])
+    doc = ts.merge_rank_traces([f1, f0])  # order must not matter
+    lanes = {e["pid"] for e in doc["traceEvents"] if e["ph"] != "M"}
+    assert lanes == {0, 1}
+    names = {(e["pid"], e["args"]["name"])
+             for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert names == {(0, "rank 0"), (1, "rank 1")}
+    r0 = [e for e in doc["traceEvents"]
+          if e["ph"] == "X" and e["pid"] == 0]
+    r1 = [e for e in doc["traceEvents"]
+          if e["ph"] == "X" and e["pid"] == 1]
+    assert r0[0]["ts"] == 10.0  # earliest anchor keeps its own zero
+    assert r1[0]["ts"] == 10.0 + 2e6  # +2s wall skew
+    assert doc["otherData"]["ranks"] == [0, 1]
+    assert doc["otherData"]["unanchored_files"] == []
+    json.loads(json.dumps(doc))  # a valid Chrome-trace JSON document
+
+
+def test_merge_ranks_cli_writes_doc_and_errors_cleanly(tmp_path, capsys):
+    f0 = _rank_log(tmp_path, 0, 50.0, [("device_steps", 0.0, 1.0)])
+    out = tmp_path / "merged.json"
+    rc = ts.main(["--merge-ranks", f0, "--out", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert {e["pid"] for e in doc["traceEvents"]} == {0}
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["ranks"] == [0] and summary["merged"] == 1
+    # Missing input: one clean error line, rc 2, no traceback.
+    rc = ts.main(["--merge-ranks", str(tmp_path / "nope.jsonl")])
+    assert rc == 2
+    captured = capsys.readouterr()
+    assert captured.err.startswith("error:")
+    assert "Traceback" not in captured.err
+
+
+def test_merge_ranks_without_anchor_keeps_own_zero(tmp_path):
+    # Pre-ISSUE-8 logs carry no clock anchor: they merge unshifted and
+    # are flagged, rather than rejected.
+    p = tmp_path / "legacy.jsonl"
+    p.write_text(json.dumps(
+        {"name": "device_steps", "ph": "X", "ts": 5.0, "dur": 1.0}
+    ) + "\n")
+    doc = ts.merge_rank_traces([str(p)])
+    ev = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert ev[0]["ts"] == 5.0 and ev[0]["pid"] == 0
+    assert doc["otherData"]["unanchored_files"] == [str(p)]
+
+
+def test_merge_ranks_survives_truncated_tail_line(tmp_path):
+    # A SIGKILLed worker's sink is routinely cut mid-line; the merge
+    # tool exists precisely for those remains, so a torn tail must be
+    # skipped (and counted), never a JSONDecodeError traceback.
+    f0 = _rank_log(tmp_path, 0, 10.0, [("device_steps", 0.0, 1.0)])
+    with open(f0, "a") as f:
+        f.write('{"name": "device_steps", "ph": "X", "ts": 99')  # torn
+    doc = ts.merge_rank_traces([f0])
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 1
+    assert doc["otherData"]["truncated_lines"] == 1
+    # summarize_host_spans shares the tolerance.
+    summary = ts.summarize_host_spans(f0)
+    assert summary["span_counts"] == {"device_steps": 1}
+
+
+def test_host_span_summary_skips_metadata_lines(tmp_path):
+    # The clock anchor the recorder now writes must not count as an
+    # instant event in the host-span summary.
+    log = tmp_path / "e.jsonl"
+    log.write_text(
+        json.dumps({"name": "clock_anchor", "ph": "M", "ts": 0,
+                    "args": {"wall_t0": 1.0}}) + "\n"
+        + json.dumps({"name": "device_steps", "ph": "X", "ts": 0.0,
+                      "dur": 100.0}) + "\n"
+    )
+    doc = ts.summarize_host_spans(str(log))
+    assert doc["instant_counts"] == {}
+    assert doc["by_span_us"] == {"device_steps": 100.0}
+
+
 def test_host_spans_flag_still_requires_a_trace(tmp_path, capsys):
     # The merge rides along a device-trace summary; a trace-less
     # invocation errors the same way with or without --host-spans.
