@@ -1,0 +1,44 @@
+"""graftlint — AST-based invariant checkers for the engine's hand-enforced
+contracts.
+
+PRs 2-8 built a trainer/server whose correctness rests on conventions no
+tool checked: host<->device syncs only in blessed harvest seams, every
+persisted artifact through ``utils.atomic_write_*``, every table mutation
+ticking ``table_version``, fault-point names in one registry, the two
+Prometheus renderers consistent with the snapshots that feed them, and
+shared mutable state accessed under its owning lock. Each of those has
+already cost a PR to get right once; this package mechanizes them as a
+jax-free analysis pass gating CI.
+
+Usage::
+
+    python -m glint_word2vec_tpu.analysis                  # report findings
+    python -m glint_word2vec_tpu.analysis --check-baseline # CI gate
+    python -m glint_word2vec_tpu.analysis --update-baseline
+
+The package imports nothing heavier than ``ast`` — no jax, no numpy — so
+the CI lint job runs on a bare Python in seconds.
+
+Per-line suppression::
+
+    something_flagged()  # graftlint: ignore[rule-id] reason it is fine
+
+The reason is mandatory; a bare suppression is itself reported (rule
+``graftlint-suppression``). The committed ``baseline.json`` holds the
+audited-and-accepted findings so the CI gate is zero-NEW-findings, not
+zero-findings; every baseline entry carries a non-empty ``note``.
+"""
+
+from glint_word2vec_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    ModuleCache,
+    checker,
+    default_targets,
+    run_analysis,
+)
+from glint_word2vec_tpu.analysis.baseline import (  # noqa: F401
+    compare_to_baseline,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,11 @@
+"""The built-in graftlint checkers. Importing this package registers
+every rule with :data:`glint_word2vec_tpu.analysis.core.CHECKERS`."""
+
+from glint_word2vec_tpu.analysis.checkers import (  # noqa: F401
+    atomic_persist,
+    fault_points,
+    lock_discipline,
+    prometheus,
+    sync_point,
+    table_mutation,
+)

@@ -1,0 +1,77 @@
+"""table-tick: no assignment to an engine table buffer outside a method
+that ticks ``table_version``.
+
+The serving result cache, the norms cache, and every downstream
+``table_version`` consumer (hot-swap, ANN rebuild plans in the ROADMAP)
+assume that EVERY mutation of ``syn0``/``syn1`` goes through
+``EmbeddingEngine._tick_tables``. A stray ``self.syn0 = ...`` in a new
+train path would silently serve stale cached results — the exact bug
+class PR 2 fixed once by centralizing the tick. The rule: inside any
+class that defines ``_tick_tables``, a method assigning a table buffer
+attribute must itself call ``self._tick_tables(...)`` (``__init__`` and
+the tick helper are exempt: construction precedes any reader).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from glint_word2vec_tpu.analysis.core import Finding, ModuleCache, checker
+from glint_word2vec_tpu.analysis.checkers.common import (
+    assign_target_attrs,
+    call_name,
+    is_self_attr,
+)
+
+RULE = "table-tick"
+
+#: The device-resident table buffers the serving caches key on.
+TABLE_ATTRS = ("syn0", "syn1")
+
+#: Methods allowed to assign tables without ticking: construction runs
+#: before any reader exists, and the tick helper is the seam itself.
+EXEMPT_METHODS = ("__init__", "_tick_tables")
+
+
+@checker(RULE,
+         "assignments to engine table buffers (syn0/syn1) must live in "
+         "methods that call self._tick_tables(...)")
+def check_table_mutation(cache: ModuleCache) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in cache.modules():
+        if mod.tree is None:
+            continue
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            if not any(m.name == "_tick_tables" for m in methods):
+                continue
+            for m in methods:
+                if m.name in EXEMPT_METHODS:
+                    continue
+                ticks = any(
+                    isinstance(n, ast.Call)
+                    and call_name(n) == "self._tick_tables"
+                    for n in ast.walk(m)
+                )
+                if ticks:
+                    continue
+                for stmt in ast.walk(m):
+                    for target in assign_target_attrs(stmt):
+                        if is_self_attr(target) and \
+                                target.attr in TABLE_ATTRS:
+                            findings.append(mod.finding(
+                                RULE, stmt,
+                                f"{cls.name}.{m.name} assigns table "
+                                f"buffer self.{target.attr} without "
+                                f"calling self._tick_tables(...)",
+                                hint="tick the version (invalidates "
+                                     "norms + serving caches) or route "
+                                     "the mutation through a ticking "
+                                     "method",
+                            ))
+    return findings

@@ -98,6 +98,7 @@ class EventRecorder:
         self.jsonl_path = jsonl_path
         self.wall_t0 = time.time()
         self._t0 = time.perf_counter()
+        # graftlint: ignore[atomic-persist] streaming JSONL sink, not an artifact: a crash leaves a valid line-prefix that the merge/summarize tools accept
         self._sink = open(jsonl_path, "w") if jsonl_path else None
         if self._sink is not None:
             # Clock-anchor metadata line (Chrome-trace "M" event, ignored
@@ -171,12 +172,15 @@ class EventRecorder:
 
     def chrome_trace(self) -> dict:
         """The ring as a ``chrome://tracing`` / Perfetto JSON document."""
+        events = self.events()
+        with self._mu:
+            dropped = self.dropped
         return {
-            "traceEvents": self.events(),
+            "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
                 "wall_t0": self.wall_t0,
-                "dropped": self.dropped,
+                "dropped": dropped,
             },
         }
 

@@ -193,22 +193,27 @@ def gang_to_prometheus(snap: dict) -> str:
         p.head(name, "gauge", help_)
         p.sample(name, None, snap.get(key))
     counters = snap.get("counters") or {}
-    for name, help_ in (
-        ("steps_total", "Optimizer steps summed over ranks."),
-        ("words_done_total", "Trained words summed over ranks."),
-        ("query_compiles_total",
+    # Full literal metric names (not f-string composed): graftlint's
+    # prom-consistency rule checks every emitted name statically, and a
+    # name it cannot resolve is a name nothing checks.
+    for name, key, help_ in [
+        ("glint_gang_steps_total", "steps_total",
+         "Optimizer steps summed over ranks."),
+        ("glint_gang_words_done_total", "words_done_total",
+         "Trained words summed over ranks."),
+        ("glint_gang_query_compiles_total", "query_compiles_total",
          "Engine query-shape compiles summed over ranks."),
-        ("async_save_waits_total",
+        ("glint_gang_async_save_waits_total", "async_save_waits_total",
          "Checkpoint back-pressure waits summed over ranks."),
-        ("canary_trips_total",
+        ("glint_gang_canary_trips_total", "canary_trips_total",
          "Divergence-canary trips summed over ranks."),
-        ("events_recorded_total",
+        ("glint_gang_events_recorded_total", "events_recorded_total",
          "Obs events recorded summed over ranks."),
-        ("events_dropped_total",
+        ("glint_gang_events_dropped_total", "events_dropped_total",
          "Obs ring evictions summed over ranks."),
-    ):
-        p.head(f"glint_gang_{name}", "counter", help_)
-        p.sample(f"glint_gang_{name}", None, counters.get(name, 0))
+    ]:
+        p.head(name, "counter", help_)
+        p.sample(name, None, counters.get(key, 0))
     per_rank = snap.get("per_rank") or {}
     p.head("glint_gang_rank_words_per_sec", "gauge",
            "Per-rank rolling trained-words/sec.")

@@ -62,7 +62,11 @@ except ImportError:  # pragma: no cover
 from glint_word2vec_tpu.corpus.alias import build_unigram_alias
 from glint_word2vec_tpu.obs import events as obs_events
 from glint_word2vec_tpu.ops import sgns
-from glint_word2vec_tpu.utils import next_pow2
+from glint_word2vec_tpu.utils import (
+    atomic_write_json,
+    atomic_write_npy,
+    next_pow2,
+)
 from glint_word2vec_tpu.ops.sampling import (
     sample_negatives,
     sample_negatives_per_row,
@@ -2067,7 +2071,7 @@ class EmbeddingEngine:
             shard_files = self._shard_manifest()
             for name, table in (("syn0", self.syn0), ("syn1", self.syn1)):
                 for fname, block in self._iter_owned_blocks(name, table):
-                    np.save(
+                    atomic_write_npy(
                         os.path.join(path, fname),
                         np.asarray(block, dtype=np.float32),
                     )
@@ -2081,19 +2085,22 @@ class EmbeddingEngine:
                 syn1 = np.asarray(self.syn1, dtype=np.float32)[
                     : self.num_rows, : self.dim
                 ]
-                np.save(os.path.join(path, "syn0.npy"), syn0)
-                np.save(os.path.join(path, "syn1.npy"), syn1)
+                atomic_write_npy(os.path.join(path, "syn0.npy"), syn0)
+                atomic_write_npy(os.path.join(path, "syn1.npy"), syn1)
         if jax.process_index() == 0:
             counts = np.asarray(self._counts_unpadded(), dtype=np.int64)
-            np.save(os.path.join(path, "counts.npy"), counts)
+            atomic_write_npy(os.path.join(path, "counts.npy"), counts)
         meta = self._save_meta(mode)
         if mode == "sharded":
             meta["shards"] = shard_files
         # Multi-host: every process wrote disjoint shard files; exactly one
         # writes the manifest (it is deterministic from mesh geometry).
+        # Per-file atomic (temp + replace, engine.json last) so a worker
+        # killed mid-save into a previously-committed dir can never leave
+        # a torn .npy behind — the in-place twin of the fresh-dir
+        # temp+rename commit.
         if jax.process_index() == 0:
-            with open(os.path.join(path, "engine.json"), "w") as f:
-                json.dump(meta, f)
+            atomic_write_json(os.path.join(path, "engine.json"), meta)
             # No integrity manifest on the multi-host in-place path (no
             # single writer sees every shard file); drop any stale one a
             # previous single-process save left so verification can't

@@ -46,6 +46,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from glint_word2vec_tpu.utils import atomic_write_json
+
 logger = logging.getLogger(__name__)
 
 #: build_argv(rank, num_workers, coordinator_port, status_file,
@@ -445,17 +447,20 @@ class Supervisor:
                         f.seek(0, os.SEEK_END)
                         f.seek(max(0, f.tell() - self.POSTMORTEM_LOG_TAIL))
                         tail = f.read()
-                    with open(
-                        os.path.join(bundle, "log_tail.txt"), "wb"
-                    ) as f:
+                    # Temp + replace: the bundle is what an operator (or
+                    # the chaos drill) reads after a crash — a torn tail
+                    # file would point the postmortem at a lie.
+                    tail_path = os.path.join(bundle, "log_tail.txt")
+                    tmp = f"{tail_path}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as f:
                         f.write(tail)
-                with open(os.path.join(bundle, "meta.json"), "w") as f:
-                    json.dump({
-                        "generation": generation,
-                        "rank": rank,
-                        "reason": reason,
-                        "collected_at": time.time(),
-                    }, f)
+                    os.replace(tmp, tail_path)
+                atomic_write_json(os.path.join(bundle, "meta.json"), {
+                    "generation": generation,
+                    "rank": rank,
+                    "reason": reason,
+                    "collected_at": time.time(),
+                })
             except OSError as e:
                 logger.warning(
                     "supervisor: postmortem collection for rank %d "

@@ -4,14 +4,15 @@ import json as _json
 import os as _os
 
 
-def atomic_write_json(path: str, obj) -> None:
+def atomic_write_json(path: str, obj, **dump_kwargs) -> None:
     """Write JSON via temp file + ``os.replace`` so a crash mid-write can
     never leave a truncated document behind (readers either see the old
     file or the complete new one). Shared by the metrics dump, the obs
-    status-file mirror, and the Chrome-trace export."""
+    status-file mirror, the Chrome-trace export, and the scripts/ bench
+    artifact writers (which pass ``indent=2`` through ``dump_kwargs``)."""
     tmp = f"{path}.tmp.{_os.getpid()}"
     with open(tmp, "w") as f:
-        _json.dump(obj, f)
+        _json.dump(obj, f, **dump_kwargs)
     _os.replace(tmp, path)
 
 

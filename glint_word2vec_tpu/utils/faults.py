@@ -51,15 +51,26 @@ from typing import Dict, Optional
 
 logger = logging.getLogger(__name__)
 
-#: Every valid injection point, so a typo'd spec fails loudly at arm
-#: time instead of silently never firing.
-POINTS = (
-    "ckpt.pre_rename",
-    "ckpt.post_rename",
-    "worker.step",
-    "producer.batch",
-    "serving.dispatch",
-)
+#: THE injection-point registry: name -> what the point means. Single
+#: source of truth — ``parse_spec``/``arm`` validate specs against it
+#: (a typo'd spec fails loudly instead of silently never firing),
+#: ``fire`` rejects undeclared names at the call site, the README
+#: fault-injection table is generated from these docstrings (asserted
+#: by tests/test_analysis.py), and graftlint's fault-point rule holds
+#: every ``faults.fire("...")`` literal in the codebase to this dict —
+#: in both directions.
+POINTS = {
+    "ckpt.pre_rename":
+        "just before a snapshot directory's atomic commit rename",
+    "ckpt.post_rename":
+        "just after the snapshot commit rename",
+    "worker.step":
+        "once per dispatched training group (all fit loops)",
+    "producer.batch":
+        "once per assembled batch group (producer thread)",
+    "serving.dispatch":
+        "once per coalesced/simple serving device dispatch",
+}
 
 _ACTIONS = ("exc", "kill", "hang", "delay")
 
@@ -148,9 +159,19 @@ def armed() -> bool:
 
 
 def fire(point: str) -> None:
-    """Hit one injection point. Free (one global read) when unarmed."""
+    """Hit one injection point. Free (one global read) when unarmed.
+
+    ``point`` must be declared in :data:`POINTS` — an undeclared name
+    raises ``ValueError`` as soon as any fault is armed, so a typo'd
+    call site cannot silently never fire against its armed spec."""
     if _ARMED is None:
         return
+    if point not in POINTS:
+        raise ValueError(
+            f"undeclared injection point {point!r} fired "
+            f"(valid: {', '.join(sorted(POINTS))}) — declare it in "
+            f"utils.faults.POINTS"
+        )
     with _MU:
         spec = _ARMED.get(point) if _ARMED is not None else None
         if spec is None:

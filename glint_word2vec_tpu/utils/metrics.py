@@ -73,7 +73,8 @@ class TrainingMetrics:
             now = time.time()
             wps = (words_done - self._words_window) / max(now - self._t_window, 1e-9)
             if loss is not None:
-                self.last_loss = float(loss)  # device sync point, on purpose
+                # graftlint: ignore[sync-point] deliberate log-cadence sync: once per log_every groups, never per step
+                self.last_loss = float(loss)
             entry = {
                 "step": self.steps,
                 "words_done": words_done,
@@ -127,6 +128,7 @@ class TrainingMetrics:
             # rather than crashing the summary; either way drop the device
             # buffer so it is not pinned for the run's lifetime.
             try:
+                # graftlint: ignore[sync-point] the one end-of-fit lazy-loss sync; the device is idle by the time summary() runs
                 self.last_loss = float(self._last_loss_lazy)
             except Exception as e:
                 # Keep the last synced loss, but never silently: a stale

@@ -66,8 +66,12 @@ def main():
         print(json.dumps(cell), flush=True)
 
     out = os.path.join(REPO, "BENCH_SWEEP.json")
-    with open(out, "w") as f:
+    # Temp + replace: a sweep interrupted mid-write keeps the previous
+    # complete artifact instead of leaving a torn one.
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump({"cells": cells}, f, indent=2)
+    os.replace(tmp, out)
     print(f"wrote {out}", file=sys.stderr)
 
 

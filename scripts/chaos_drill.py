@@ -155,6 +155,7 @@ def main() -> int:
     sup_dir = os.path.join(tmp, "supervisor")
     report_path = os.path.join(tmp, "report.json")
     sentences = _make_tiny_corpus()
+    # graftlint: ignore[atomic-persist] corpus fixture in this drill's private tmp dir; nothing reads it across a crash
     with open(corpus, "w") as f:
         for s in sentences:
             f.write(" ".join(s) + "\n")
@@ -211,6 +212,7 @@ def main() -> int:
     )
     t0 = time.time()
     sup_log = os.path.join(tmp, "supervise.log")
+    # graftlint: ignore[atomic-persist] live stdout/stderr sink for the supervise subprocess — a stream, not an artifact
     with open(sup_log, "wb") as logf:
         proc = subprocess.Popen(argv, stdout=logf,
                                 stderr=subprocess.STDOUT)
@@ -351,8 +353,9 @@ def main() -> int:
     out["quality"] = quality
     out["checks"] = checks
 
-    with open(OUT, "w") as f:
-        json.dump(out, f, indent=2)
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(OUT, out, indent=2)
     print(json.dumps(out, indent=2))
     if not all(checks.values()):
         print("chaos drill FAILED gates:", [

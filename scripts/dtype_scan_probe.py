@@ -83,8 +83,9 @@ def main():
            "vocab": V, "dim": d, "batch": B}
 
     def flush():
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=2)
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.out, res, indent=2)
 
     mesh = make_mesh(1, 1, devices=[jax.devices()[0]])
     ranks = np.arange(1, V + 1, dtype=np.float64)

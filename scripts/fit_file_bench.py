@@ -119,8 +119,9 @@ def main():
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "FITFILE.json",
         )
-        with open(dst, "w") as f:
-            json.dump(out, f, indent=2)
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(dst, out, indent=2)
     model.stop()
 
 

@@ -174,8 +174,9 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "HOSTPATH.json",
     )
-    with open(out, "w") as f:
-        json.dump(res, f, indent=2)
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(out, res, indent=2)
     print(json.dumps(res, indent=2))
 
 

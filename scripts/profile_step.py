@@ -72,8 +72,9 @@ def main():
     res = {"dtype": args.dtype}
 
     def flush():
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=2)
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.out, res, indent=2)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     rng = np.random.default_rng(0)

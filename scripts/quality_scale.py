@@ -168,8 +168,9 @@ def main():
             ),
         },
     })
-    with open(OUT, "w") as f:
-        json.dump(doc, f, indent=2, ensure_ascii=False)
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(OUT, doc, indent=2, ensure_ascii=False)
     print(json.dumps(doc["summary"]))
 
 

@@ -306,8 +306,9 @@ def run(corpus: str, out_path: str, n_seeds: int = 5) -> dict:
         "control_top1": ext["top1_mean"], "control_top5": ext["top5_mean"],
         "pass": results["summary"]["meets_external_control"],
     }
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2, ensure_ascii=False)
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(out_path, results, indent=2, ensure_ascii=False)
     print(json.dumps(results["summary"]))
     return results
 

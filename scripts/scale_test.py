@@ -182,8 +182,9 @@ def main() -> None:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "SCALE.json",
     )
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(path, out, indent=2)
     print(json.dumps(out))
 
 

@@ -50,8 +50,9 @@ def main():
     res = {"device": str(jax.devices()[0])}
 
     def flush():
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=2)
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.out, res, indent=2)
 
     from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
     from glint_word2vec_tpu.parallel.mesh import make_mesh

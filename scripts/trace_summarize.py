@@ -59,6 +59,16 @@ _CLASSES = [
 ]
 
 
+def _atomic_dump(path: str, doc: dict, **kw) -> None:
+    """Temp + ``os.replace``: an interrupted ``--out`` write keeps the
+    previous complete summary (same contract as utils.atomic_write_json,
+    inlined to keep this script package-import-free)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, **kw)
+    os.replace(tmp, path)
+
+
 def classify(name: str) -> str:
     low = name.lower()
     for cls, pat in _CLASSES:
@@ -306,8 +316,7 @@ def main(argv=None) -> int:
             return 2
         doc = merge_rank_traces(args.merge_ranks)
         if args.out:
-            with open(args.out, "w") as f:
-                json.dump(doc, f)
+            _atomic_dump(args.out, doc)
         print(json.dumps({
             "merged": len(args.merge_ranks),
             "ranks": doc["otherData"]["ranks"],
@@ -333,8 +342,7 @@ def main(argv=None) -> int:
     if args.host_spans:
         doc["host_spans"] = summarize_host_spans(args.host_spans)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(doc, f, indent=2)
+        _atomic_dump(args.out, doc, indent=2)
     print(json.dumps(doc))
     return 0
 
