@@ -53,6 +53,10 @@ SYNC_SEAMS: Dict[str, str] = {
     "Word2Vec._fit_with_batcher._harvest_host":
         "host-batcher twin of the deferred harvest: one-group-lagged "
         "loss/word records",
+    "glint_word2vec_tpu/streaming/trainer.py::StreamTrainer._harvest":
+        "streaming mini-epoch harvest seam (ISSUE 10): syncs one "
+        "dispatched group's result scalars; the buffer is already "
+        "uploaded, so nothing starves behind the sync",
     # Checkpoint harvest: device->host shard copies on the save path
     # run on the caller thread by design (PR 5's async protocol).
     "glint_word2vec_tpu/parallel/engine.py::"

@@ -7,6 +7,7 @@ separate server process to launch (the "cluster" is the device mesh inside
 this process), so the CLI collapses to:
 
   python -m glint_word2vec_tpu.cli train   --corpus c.txt --output m/ [...]
+  python -m glint_word2vec_tpu.cli fit-stream --corpus - --publish-dir p/ [...]
   python -m glint_word2vec_tpu.cli synonyms --model m/ --word w [-n 10]
   python -m glint_word2vec_tpu.cli analogy  --model m/ --positive a b --negative c
   python -m glint_word2vec_tpu.cli transform --model m/ --sentence "w1 w2 w3"
@@ -141,6 +142,89 @@ def _add_train(sub):
                    help="max subword rows per word (fastText family)")
 
 
+def _add_fit_stream(sub):
+    p = sub.add_parser(
+        "fit-stream",
+        help="incremental (ISGNS) training on an unbounded sentence "
+             "stream: online vocab growth, adaptive distributions, and "
+             "committed generation publishing a `serve "
+             "--watch-checkpoint` fleet hot-swaps under load",
+    )
+    p.add_argument("--corpus", default="-",
+                   help="sentence source, one per line: a file path, or "
+                        "'-' (default) for stdin — pipe a live feed in")
+    p.add_argument("--follow", action="store_true",
+                   help="tail the --corpus file forever (tail -f "
+                        "semantics): keep polling for appended lines "
+                        "instead of stopping at EOF")
+    p.add_argument("--lowercase", action="store_true")
+    p.add_argument("--publish-dir", default=None,
+                   help="publish committed model generations here "
+                        "(gen-NNNNNN dirs + LATEST.json pointer) for "
+                        "serving replicas to hot-swap")
+    p.add_argument("--publish-every", type=float, default=30.0,
+                   help="seconds between generation publishes "
+                        "(default 30; whichever of the time/word "
+                        "cadences fires first publishes)")
+    p.add_argument("--publish-words", type=int, default=None,
+                   help="also publish every N trained words")
+    p.add_argument("--output", default=None,
+                   help="save the final model here when the stream ends")
+    p.add_argument("--bootstrap-words", type=int, default=10000,
+                   help="stream prefix scanned batch-style to seed the "
+                        "base vocabulary (default 10000 words)")
+    p.add_argument("--buffer-words", type=int, default=65536,
+                   help="mini-epoch buffer capacity in words (fixed "
+                        "shape: every round reuses the same compiled "
+                        "programs; default 65536)")
+    p.add_argument("--extra-rows", type=int, default=1024,
+                   help="spare table rows reserved for online vocab "
+                        "growth — the promotion budget (default 1024)")
+    p.add_argument("--refresh-words", type=int, default=None,
+                   help="kept-word cadence for recomputing the adaptive "
+                        "noise/subsample distributions (default: one "
+                        "buffer)")
+    p.add_argument("--max-words", type=int, default=None,
+                   help="stop after training this many words (bounded "
+                        "runs/smokes; default: run until the stream ends)")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="stop after this much wall time")
+    p.add_argument("--vector-size", type=int, default=100)
+    p.add_argument("--window", type=int, default=5)
+    p.add_argument("--step-size", type=float, default=0.01875)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--negatives", type=int, default=5)
+    p.add_argument("--subsample-ratio", type=float, default=0.0)
+    p.add_argument("--min-count", type=int, default=5,
+                   help="bootstrap admission AND promotion threshold "
+                        "(a candidate's guaranteed sketch count must "
+                        "clear it)")
+    p.add_argument("--max-sentence-length", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--num-partitions", type=int, default=1)
+    p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--steps-per-call", type=int, default=16)
+    p.add_argument("--metrics-out", default=None,
+                   help="write final training metrics JSON here "
+                        "(atomic write)")
+    obs = p.add_argument_group(
+        "observability",
+        "live heartbeat with the streaming gauge set: stream lag, "
+        "vocab growth, distribution drift, publish cadence "
+        "(glint_stream_* in the Prometheus exposition)",
+    )
+    obs.add_argument("--status-port", type=int, default=None,
+                     help="serve /healthz + /metrics for the stream "
+                          "trainer (0 binds an ephemeral port)")
+    obs.add_argument("--status-host", default="127.0.0.1")
+    obs.add_argument("--status-file", default=None,
+                     help="atomically mirror the status snapshot JSON "
+                          "to this path")
+    obs.add_argument("--event-log", default=None,
+                     help="JSONL span/event log (stream_fill, "
+                          "device_steps, publish, table mutations)")
+
+
 def _add_query(sub):
     p = sub.add_parser("synonyms", help="nearest neighbors of a word")
     p.add_argument("--model", required=True)
@@ -166,9 +250,21 @@ def _add_query(sub):
              "deployment analogue: trainers/clients come and go, the "
              "model stays resident)",
     )
-    p.add_argument("--model", required=True)
+    p.add_argument("--model", default=None,
+                   help="saved model directory (optional when "
+                        "--watch-checkpoint names a publish dir: the "
+                        "newest committed generation boots the server)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8801)
+    p.add_argument("--watch-checkpoint", default=None, metavar="DIR",
+                   help="follow a fit-stream publish directory: each "
+                        "new committed generation (LATEST.json) is "
+                        "staged off the request path and hot-swapped "
+                        "into the live engine — no dropped requests, "
+                        "no post-warmup compiles (POST /reload forces "
+                        "an immediate poll)")
+    p.add_argument("--watch-poll", type=float, default=1.0,
+                   help="seconds between LATEST.json polls (default 1)")
     p.add_argument("--max-batch", type=int, default=64,
                    help="coalesced /synonyms dispatch cap (rounded up to "
                         "a power of two; Q shape buckets warm up to it)")
@@ -283,6 +379,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="glint_word2vec_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
     _add_train(sub)
+    _add_fit_stream(sub)
     _add_query(sub)
     args = parser.parse_args(argv)
     try:
@@ -384,6 +481,141 @@ def _run_supervise(args) -> int:
     return 0 if report.completed else 3
 
 
+def _stream_sentences(path: str, follow: bool, lowercase: bool):
+    """Tokenized sentences from a file, stdin (``-``), or a followed
+    (tail -f) file. The generator is pull-based: a bounded trainer
+    (--max-words/--max-seconds) simply stops pulling. While a followed
+    file is idle it yields ``[]`` heartbeats so the trainer can honor
+    its stop bounds and publish cadence instead of blocking in the
+    fill loop; a half-written trailing line (the producer's write
+    landed between flushes) is held until its newline arrives so
+    partial tokens never reach the counts or the candidate sketch."""
+    import time as _time
+
+    def _toks(line):
+        return (line.lower() if lowercase else line).split()
+
+    if path != "-" and not follow:
+        # Plain file: the batch paths' line streamer already does
+        # exactly this (same tokenization policy, one implementation).
+        from glint_word2vec_tpu.corpus.vocab import iter_text_file
+
+        yield from iter_text_file(path, lowercase)
+        return
+    if path == "-":
+        try:
+            fd = sys.stdin.fileno()
+        except (OSError, ValueError, AttributeError):
+            fd = None
+        if fd is None:
+            # Not a real descriptor (tests substituting StringIO):
+            # plain blocking iteration, no idle heartbeats possible.
+            for line in sys.stdin:
+                toks = _toks(line)
+                if toks:
+                    yield toks
+            return
+        # A quiet pipe must not pin the trainer inside its fill loop:
+        # select with a timeout and yield [] heartbeats while idle, so
+        # --max-seconds and --publish-every stay live, holding any
+        # half-written trailing line until its newline arrives.
+        import codecs
+        import os as _os
+        import select as _select
+
+        dec = codecs.getincrementaldecoder("utf-8")("replace")
+        pending = ""
+        while True:
+            ready, _, _ = _select.select([fd], [], [], 0.2)
+            if not ready:
+                yield []  # idle heartbeat
+                continue
+            chunk = _os.read(fd, 65536)
+            if not chunk:  # EOF
+                pending += dec.decode(b"", final=True)
+                toks = _toks(pending)  # final newline-less line
+                if toks:
+                    yield toks
+                return
+            pending += dec.decode(chunk)
+            *lines, pending = pending.split("\n")
+            for line in lines:
+                toks = _toks(line)
+                if toks:
+                    yield toks
+    # --follow: tail the file forever, holding a half-written trailing
+    # line until its newline lands.
+    with open(path, encoding="utf-8") as f:
+        pending = ""
+        while True:
+            line = f.readline()
+            if not line:
+                _time.sleep(0.2)
+                yield []  # idle heartbeat
+                continue
+            line = pending + line
+            pending = ""
+            if not line.endswith("\n"):
+                pending = line
+                continue
+            toks = _toks(line)
+            if toks:
+                yield toks
+
+
+def _run_fit_stream(args) -> int:
+    from glint_word2vec_tpu import Word2Vec
+
+    obs = None
+    if args.status_port is not None or args.status_file or args.event_log:
+        from glint_word2vec_tpu.obs import ObsConfig
+
+        obs = ObsConfig(
+            event_log=args.event_log,
+            status_port=args.status_port,
+            status_host=args.status_host,
+            status_file=args.status_file,
+        )
+    w2v = Word2Vec(
+        vector_size=args.vector_size,
+        window=args.window,
+        step_size=args.step_size,
+        batch_size=args.batch_size,
+        num_negatives=args.negatives,
+        subsample_ratio=args.subsample_ratio,
+        min_count=args.min_count,
+        max_sentence_length=args.max_sentence_length,
+        seed=args.seed,
+        num_partitions=args.num_partitions,
+        num_shards=args.num_shards,
+        steps_per_call=args.steps_per_call,
+        obs=obs,
+    )
+    model = w2v.fit_stream(
+        _stream_sentences(args.corpus, args.follow, args.lowercase),
+        publish_dir=args.publish_dir,
+        bootstrap_words=args.bootstrap_words,
+        buffer_words=args.buffer_words,
+        extra_rows=args.extra_rows,
+        refresh_words=args.refresh_words,
+        publish_seconds=args.publish_every,
+        publish_words=args.publish_words,
+        max_words=args.max_words,
+        max_seconds=args.max_seconds,
+    )
+    if args.output:
+        model.save(args.output)
+    print(json.dumps({
+        **({"saved": args.output} if args.output else {}),
+        **(model.training_metrics or {}),
+    }))
+    if args.metrics_out:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.metrics_out, model.training_metrics)
+    return 0
+
+
 def _run(args) -> int:
     if args.cmd == "supervise":
         # Before force_platform/jax: the supervisor process never
@@ -465,9 +697,18 @@ def _run(args) -> int:
             atomic_write_json(args.metrics_out, model.training_metrics)
         return 0
 
+    if args.cmd == "fit-stream":
+        return _run_fit_stream(args)
+
     if args.cmd == "serve":
         from glint_word2vec_tpu.serving import serve_model_dir
 
+        if args.model is None and args.watch_checkpoint is None:
+            print(
+                "error: serve needs --model or --watch-checkpoint",
+                file=sys.stderr,
+            )
+            return 1
         serve_model_dir(
             args.model, host=args.host, port=args.port,
             max_batch=args.max_batch, warmup=not args.no_warmup,
@@ -475,6 +716,8 @@ def _run(args) -> int:
             max_inflight=args.max_inflight,
             request_deadline=args.request_deadline,
             degraded_after=args.degraded_after,
+            watch_dir=args.watch_checkpoint,
+            watch_poll=args.watch_poll,
         )
         return 0
 

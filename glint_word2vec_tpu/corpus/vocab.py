@@ -153,6 +153,38 @@ def build_vocab(
     )
 
 
+def saved_model_vocabulary(
+    model_dir: str, counts: np.ndarray, expected_rows: int
+) -> Vocabulary:
+    """Vocabulary for a saved model/generation directory — the cold
+    load (``Word2VecModel.load``) and the serving hot-swap stage the
+    same layout through this one helper: read ``words.txt``, validate
+    the entry count against the matrix's queryable rows, and zero-pad
+    the counts for words promoted onto extra rows (their live counts
+    are trainer state, never persisted with a snapshot)."""
+    import os
+
+    with open(os.path.join(model_dir, "words.txt"), encoding="utf-8") as f:
+        words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+    if len(words) != expected_rows:
+        raise ValueError(
+            f"corrupt model dir at {model_dir}: words.txt has "
+            f"{len(words)} entries, the matrix claims {expected_rows} "
+            "queryable rows"
+        )
+    counts = np.asarray(counts, dtype=np.int64)
+    if len(words) > counts.shape[0]:
+        counts = np.concatenate(
+            [counts, np.zeros(len(words) - counts.shape[0], np.int64)]
+        )
+    return Vocabulary(
+        words=words,
+        counts=counts[: len(words)],
+        word_index={w: i for i, w in enumerate(words)},
+        train_words_count=int(counts.sum()),
+    )
+
+
 def iter_text_file(path: str, lowercase: bool = False) -> Iterator[List[str]]:
     """Stream whitespace-tokenized sentences from a text file, one per line."""
     with open(path, "r", encoding="utf-8", errors="replace") as f:

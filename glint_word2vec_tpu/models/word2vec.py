@@ -37,7 +37,11 @@ from glint_word2vec_tpu.corpus.batching import (
     encode_sentences,
     group_batches,
 )
-from glint_word2vec_tpu.corpus.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.corpus.vocab import (
+    Vocabulary,
+    build_vocab,
+    saved_model_vocabulary,
+)
 from glint_word2vec_tpu.obs import TrainingDiverged, start_run
 from glint_word2vec_tpu.utils import faults, next_pow2
 from glint_word2vec_tpu.utils.metrics import TrainingMetrics
@@ -405,6 +409,30 @@ class Word2Vec:
             vocab, ids, offsets, checkpoint_dir, checkpoint_every_epochs,
             stop_after_epochs,
         )
+
+    def fit_stream(
+        self,
+        sentences: Iterable[Sequence[str]],
+        publish_dir: Optional[str] = None,
+        **stream_kw,
+    ) -> "Word2VecModel":
+        """Incremental training on an unbounded sentence stream (ISSUE
+        10, the ISGNS construction arXiv:1704.03956): one look at each
+        sentence, adaptive noise/subsample distributions recomputed
+        from live counts on a cadence, online vocabulary growth onto
+        the engine's spare extra rows, and — with ``publish_dir`` —
+        committed model generations published for a serving fleet to
+        hot-swap under load (streaming/publish.py).
+
+        Returns the fitted model when the stream ends or a
+        ``max_words``/``max_seconds`` bound trips. Cadence and capacity
+        knobs are forwarded to
+        :class:`glint_word2vec_tpu.streaming.trainer.StreamTrainer`."""
+        from glint_word2vec_tpu.streaming.trainer import StreamTrainer
+
+        return StreamTrainer(
+            self, publish_dir=publish_dir, **stream_kw
+        ).run(sentences)
 
     def _fit_flat(
         self,
@@ -1581,25 +1609,15 @@ class Word2VecModel:
                     f"params.json at {path} does not describe a "
                     f"{cls._PARAMS_CLS.__name__} model: {e}"
                 )
-        with open(os.path.join(path, "words.txt"), encoding="utf-8") as f:
-            words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
         if mesh is None:
             n_dev = len(jax.devices())
             num_model = max(1, min(params.num_shards, n_dev))
             num_data = max(1, min(params.num_partitions, n_dev // num_model))
             mesh = make_mesh(num_data, num_model)
         engine = EmbeddingEngine.load(os.path.join(path, "matrix"), mesh)
-        counts = engine._counts
-        if len(words) != engine.vocab_size:
-            raise ValueError(
-                f"corrupt model at {path}: words file has {len(words)} "
-                f"entries but the matrix holds {engine.vocab_size} rows"
-            )
-        vocab = Vocabulary(
-            words=words,
-            counts=counts,
-            word_index={w: i for i, w in enumerate(words)},
-            train_words_count=int(counts.sum()),
+        vocab = saved_model_vocabulary(
+            path, engine._counts,
+            engine.vocab_size + engine.extra_rows_assigned,
         )
         return cls._from_loaded(vocab, engine, params)
 

@@ -169,6 +169,9 @@ class _NullRun:
     def update(self, **kw) -> None:
         pass
 
+    def update_streaming(self, **kw) -> None:
+        pass
+
     def observe_losses(self, first_step: int, losses, n_real: int) -> None:
         pass
 
@@ -269,6 +272,13 @@ class ObsRun:
 
     def update(self, **kw) -> None:
         self.status.update(**kw)
+        self._write_status()
+
+    def update_streaming(self, **kw) -> None:
+        """Streaming-trainer gauge hook (ISSUE 10): forwards to
+        ``TrainingStatus.set_streaming`` and mirrors the status file on
+        the usual cadence."""
+        self.status.set_streaming(**kw)
         self._write_status()
 
     def observe_losses(self, first_step: int, losses, n_real: int) -> None:

@@ -108,6 +108,10 @@ class TrainingStatus:
         self.alpha: Optional[float] = None
         self.canary = {"mode": "off", "trips": 0, "last_reason": None}
         self.unhealthy_reason: Optional[str] = None
+        # Streaming gauges (ISSUE 10): None until a fit_stream loop
+        # calls set_streaming, so batch fits serve unchanged snapshots.
+        self._streaming: Optional[dict] = None
+        self._last_publish_unix: Optional[float] = None
         # Supervisor handshake (parallel/supervisor.py): echo the launch
         # generation back in every snapshot so the supervisor can tell a
         # live heartbeat of the CURRENT gang from a stale pre-restart
@@ -154,6 +158,36 @@ class TrainingStatus:
                 "mode": mode, "trips": int(trips), "last_reason": last_reason,
             }
 
+    def set_streaming(self, *, words_streamed=0, sentences_streamed=0,
+                      oov_words=0, vocab_size=0, promoted_words=0,
+                      extra_rows_free=0, sketch_fill=0.0,
+                      noise_drift_l1=None, stream_lag_seconds=None,
+                      generations_published=0, last_publish_unix=None,
+                      buffer_fill=None) -> None:
+        """Install the streaming trainer's gauge set (ISSUE 10): stream
+        progress, online-vocab growth, distribution drift, and publish
+        cadence — the keys ``training_to_prometheus`` renders as
+        ``glint_stream_*``. Publish age is computed at snapshot time
+        from the stored unix stamp so the gauge stays live between
+        updates."""
+        with self._mu:
+            self._last_publish_unix = last_publish_unix
+            # Counters arrive as plain host ints from the trainer; the
+            # float-ish gauges go through the NaN-safe JSON guard.
+            self._streaming = {
+                "words_streamed_total": words_streamed,
+                "sentences_streamed_total": sentences_streamed,
+                "oov_words_total": oov_words,
+                "stream_vocab_size": vocab_size,
+                "promoted_words_total": promoted_words,
+                "extra_rows_free": extra_rows_free,
+                "sketch_fill": _finite_or_none(sketch_fill),
+                "noise_drift_l1": _finite_or_none(noise_drift_l1),
+                "stream_lag_seconds": _finite_or_none(stream_lag_seconds),
+                "generations_published_total": generations_published,
+                "buffer_fill": _finite_or_none(buffer_fill),
+            }
+
     def mark_unhealthy(self, reason: str) -> None:
         """Flip the worker to ``unhealthy`` so ``/healthz`` answers 503
         (fleet probes and the supervisor work off status codes, not
@@ -189,6 +223,13 @@ class TrainingStatus:
                 "supervisor_generation": self.supervisor_generation,
                 "unhealthy_reason": self.unhealthy_reason,
             }
+            if self._streaming is not None:
+                streaming = dict(self._streaming)
+                streaming["last_publish_age_seconds"] = _finite_or_none(
+                    time.time() - self._last_publish_unix
+                    if self._last_publish_unix else None
+                )
+                snap["streaming"] = streaming
         if m is not None:
             # last_loss is whatever the metrics layer last SYNCED — the
             # heartbeat never forces a device sync of its own.

@@ -46,7 +46,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -279,6 +279,7 @@ class EmbeddingEngine:
             raise ValueError("shared_negatives must be >= 0")
         self.mesh = mesh
         self.vocab_size = int(vocab_size)
+        self._seed = int(seed)  # graftlint: ignore[sync-point] host config scalar
         self.num_rows = int(vocab_size) + int(extra_rows)
         self.dim = int(dim)
         self.num_negatives = int(num_negatives)
@@ -923,26 +924,31 @@ class EmbeddingEngine:
 
         norms_spec = rep if dims else P(MODEL_AXIS)
 
-        def _mask_terms(norms_l, start):
+        def _mask_terms(norms_l, start, n_queryable):
             # Cosine masking as one multiply + one add instead of a
             # division plus two (.., V)-wide boolean selects: inv is the
             # reciprocal norm (0 on masked rows), neg pins masked rows
             # at -inf. Zero-norm rows must never outrank a real word
             # with negative cosine (the reference's zero-norm guard at
-            # mllib:603-609 only had to avoid a 0/0); likewise rows past
-            # vocab_size (padding / subword buckets): only real words
-            # may surface from similarity search. Both vectors are (V,)
-            # so the per-score work is a fused multiply-add — on the
-            # serving path this cut batch top-k time ~30% (SERVING_BENCH).
+            # mllib:603-609 only had to avoid a 0/0); likewise rows at or
+            # past ``n_queryable`` (padding / subword buckets / spare
+            # extra rows not yet assigned a streaming word): only real
+            # words may surface from similarity search. ``n_queryable``
+            # is a TRACED scalar — vocab_size + assigned extra rows —
+            # so online vocab growth (streaming hot-swap, ISSUE 10)
+            # widens the mask without recompiling any warmed top-k
+            # program. Both vectors are (V,) so the per-score work is a
+            # fused multiply-add — on the serving path this cut batch
+            # top-k time ~30% (SERVING_BENCH).
             ok = (norms_l > 0) & (
-                start + jnp.arange(norms_l.shape[0]) < self.vocab_size
+                start + jnp.arange(norms_l.shape[0]) < n_queryable
             )
             inv = jnp.where(ok, 1.0 / jnp.where(norms_l > 0, norms_l, 1.0), 0.0)
             neg = jnp.where(ok, 0.0, -jnp.inf)
             return inv, neg
 
         def make_topk(k: int):
-            def local_topk(table_l, v, norms_l):
+            def local_topk(table_l, v, norms_l, nq):
                 if dims:
                     # Partial scores over local columns, psum'd to full
                     # cosine scores (replicated), then ranked. The psum
@@ -951,7 +957,7 @@ class EmbeddingEngine:
                         table_l.astype(jnp.float32) @ _local_cols(v),
                         MODEL_AXIS,
                     )  # (V,)
-                    inv, neg = _mask_terms(norms_l, 0)
+                    inv, neg = _mask_terms(norms_l, 0, nq)
                     val, idx = lax.top_k(
                         scores * inv + neg, min(k, scores.shape[0])
                     )
@@ -963,7 +969,7 @@ class EmbeddingEngine:
                 start = lax.axis_index(MODEL_AXIS) * Vs
                 kk = min(k, Vs)
                 scores = table_l.astype(jnp.float32) @ v
-                inv, neg = _mask_terms(norms_l, start)
+                inv, neg = _mask_terms(norms_l, start, nq)
                 val, idx = lax.top_k(scores * inv + neg, kk)
                 cand_val = lax.all_gather(val, MODEL_AXIS, tiled=True)
                 cand_idx = lax.all_gather(idx + start, MODEL_AXIS, tiled=True)
@@ -973,13 +979,13 @@ class EmbeddingEngine:
             return jax.jit(
                 self._shard_map(
                     local_topk,
-                    in_specs=(tspec, rep, norms_spec),
+                    in_specs=(tspec, rep, norms_spec, rep),
                     out_specs=(rep, rep),
                 )
             )
 
         def make_topk_batch(k: int):
-            def local_topk_batch(table_l, q, norms_l):
+            def local_topk_batch(table_l, q, norms_l, nq):
                 # Scores are computed as (table @ q.T).T, not q @ table.T:
                 # the tall-skinny orientation streams the row-major table
                 # once (bandwidth-bound like the single-query matvec) —
@@ -995,7 +1001,7 @@ class EmbeddingEngine:
                     scores = lax.psum(
                         (table_l.astype(jnp.float32) @ q_l.T).T, MODEL_AXIS
                     )  # (Q, V)
-                    inv, neg = _mask_terms(norms_l, 0)
+                    inv, neg = _mask_terms(norms_l, 0, nq)
                     val, idx = lax.top_k(
                         scores * inv[None, :] + neg[None, :],
                         min(k, scores.shape[1]),
@@ -1007,7 +1013,7 @@ class EmbeddingEngine:
                 start = lax.axis_index(MODEL_AXIS) * Vs
                 kk = min(k, Vs)
                 scores = (table_l.astype(jnp.float32) @ q.T).T  # (Q, Vs)
-                inv, neg = _mask_terms(norms_l, start)
+                inv, neg = _mask_terms(norms_l, start, nq)
                 val, idx = lax.top_k(
                     scores * inv[None, :] + neg[None, :], kk
                 )  # (Q, kk)
@@ -1025,7 +1031,7 @@ class EmbeddingEngine:
             return jax.jit(
                 self._shard_map(
                     local_topk_batch,
-                    in_specs=(tspec, rep, norms_spec),
+                    in_specs=(tspec, rep, norms_spec, rep),
                     out_specs=(rep, rep),
                 )
             )
@@ -1048,6 +1054,14 @@ class EmbeddingEngine:
         # derived from table values without holding device buffers.
         self._norms_cache = None
         self.table_version = 0
+        #: Spare extra rows claimed for runtime vocabulary growth
+        #: (ISSUE 10 streaming): rows [vocab_size, vocab_size +
+        #: extra_rows_assigned) hold words assigned online via
+        #: :meth:`assign_extra_row` and ARE queryable (the top-k mask
+        #: bound is the traced ``queryable_rows`` scalar, so growth
+        #: never recompiles a warmed program). FastText bucket rows are
+        #: NOT assigned this way and stay masked.
+        self.extra_rows_assigned = 0
         # Non-blocking checkpoint machinery (ISSUE 5): the single
         # background writer (lazily created by save_async) and the
         # commit telemetry the heartbeat surfaces.
@@ -1205,24 +1219,40 @@ class EmbeddingEngine:
     # Corpus-resident training (device-side batch assembly)
     # ------------------------------------------------------------------
 
-    def upload_corpus(self, ids: np.ndarray, offsets: np.ndarray) -> None:
+    def upload_corpus(self, ids: np.ndarray, offsets: np.ndarray,
+                      n_valid: Optional[int] = None) -> None:
         """Upload the flat encoded corpus (corpus/vocab.encode_file's
         ``(ids, offsets)``) to device HBM once. Subsequent
         :meth:`train_steps_corpus` dispatches assemble minibatches
         entirely on device (ops/device_batching) — per-dispatch
         host->device traffic drops to scalars. ~4 bytes/word of HBM
         replicated per device (~12 with the subsampled path's compacted
-        buffers, see :meth:`compact_corpus`)."""
+        buffers, see :meth:`compact_corpus`).
+
+        ``n_valid`` bounds the live center positions to a PREFIX of the
+        buffer: positions at or past it never train (they become
+        zero-mask lanes inside the scan). The streaming trainer (ISSUE
+        10) re-fills one fixed-capacity buffer per mini-epoch and passes
+        the real fill here — the bound is a traced scalar in the
+        compiled scan, so every round reuses the same warmed program
+        regardless of how many words the stream delivered."""
         n = int(np.asarray(ids).shape[0])
         if n < 1 or n >= 2**31 or int(np.asarray(offsets)[-1]) != n:
             raise ValueError(
                 "corpus must be non-empty with offsets[-1] == len(ids) "
                 f"< 2**31 (got len(ids)={n})"
             )
+        if n_valid is None:
+            n_valid = n
+        if not 0 <= int(n_valid) <= n:
+            raise ValueError(
+                f"n_valid ({n_valid}) must be in [0, len(ids)={n}]"
+            )
         self._corpus = (
             jnp.asarray(ids, dtype=jnp.int32),
             jnp.asarray(offsets, dtype=jnp.int32),
         )
+        self._corpus_n_valid = int(n_valid)
         self._corpus_compacted = None
         self._n_kept = None
 
@@ -1259,6 +1289,16 @@ class EmbeddingEngine:
         if getattr(self, "_keep_prob", None) is None:
             raise ValueError(
                 "no keep probabilities installed (call set_keep_probs first)"
+            )
+        if self._corpus_n_valid != int(self._corpus[0].shape[0]):
+            # The device pass draws keep masks over the WHOLE static
+            # buffer; a bounded prefix view would compact dead padding
+            # tokens into the live stream. The streaming trainer
+            # subsamples host-side while filling the buffer instead.
+            raise ValueError(
+                "on-device subsampling over an n_valid-bounded corpus "
+                "view is unsupported (subsample host-side when filling "
+                "the buffer)"
             )
         old = self._corpus_compacted
         self._corpus_compacted = None
@@ -1372,7 +1412,7 @@ class EmbeddingEngine:
             n_valid = self._n_kept
         else:
             ids, soffs = self._corpus
-            n_valid = ids.shape[0]
+            n_valid = getattr(self, "_corpus_n_valid", ids.shape[0])
         self.syn0, self.syn1, losses = fn(
             self.syn0, self.syn1, self._prob, self._alias, ids, soffs,
             jnp.int32(n_valid), jnp.int32(start_position), base_key,
@@ -1450,7 +1490,7 @@ class EmbeddingEngine:
             n_valid = self._n_kept
         else:
             ids, soffs = self._corpus
-            n_valid = ids.shape[0]
+            n_valid = getattr(self, "_corpus_n_valid", ids.shape[0])
         (
             self.syn0, self.syn1, losses, pair_counts, pos_ends, alphas,
         ) = fn(
@@ -1520,12 +1560,10 @@ class EmbeddingEngine:
             self.syn0, idx, jnp.asarray(mask, dtype=jnp.float32)
         )
 
-    def write_rows(self, start_row: int, rows: jax.Array) -> None:
-        """Overwrite ``rows.shape[0]`` consecutive syn0 rows starting at
-        ``start_row``, entirely on device (used to assemble derived tables,
-        e.g. composed subword vectors, without a host round-trip). The
-        start index is a traced argument, so chunked writers compile once
-        per chunk shape."""
+    def _row_writer(self):
+        """Lazily-built jitted row-block writer shared by
+        :meth:`write_rows` and the extra-row assignment path: one
+        compiled program per block shape, start row traced."""
         if not hasattr(self, "_write_rows_fn"):
             self._write_rows_fn = jax.jit(
                 lambda table, block, s: jax.lax.dynamic_update_slice(
@@ -1534,13 +1572,193 @@ class EmbeddingEngine:
                 out_shardings=self._table_sharding(),
                 donate_argnums=(0,),
             )
+        return self._write_rows_fn
+
+    def write_rows(self, start_row: int, rows: jax.Array) -> None:
+        """Overwrite ``rows.shape[0]`` consecutive syn0 rows starting at
+        ``start_row``, entirely on device (used to assemble derived tables,
+        e.g. composed subword vectors, without a host round-trip). The
+        start index is a traced argument, so chunked writers compile once
+        per chunk shape."""
+        fn = self._row_writer()
         pad = self.padded_dim - self.dim
         if pad:
             rows = jnp.pad(rows, ((0, 0), (0, pad)))
-        self.syn0 = self._write_rows_fn(
-            self.syn0, rows, jnp.int32(start_row)
-        )
+        self.syn0 = fn(self.syn0, rows, jnp.int32(start_row))
         self._tick_tables("write_rows")
+
+    # ------------------------------------------------------------------
+    # Runtime vocabulary growth (ISSUE 10 streaming)
+    # ------------------------------------------------------------------
+
+    @property
+    def extra_rows_total(self) -> int:
+        """Spare non-vocabulary rows reserved at construction."""
+        return self.num_rows - self.vocab_size
+
+    @property
+    def extra_rows_free(self) -> int:
+        """Spare rows still available to :meth:`assign_extra_row`."""
+        return self.extra_rows_total - self.extra_rows_assigned
+
+    @property
+    def queryable_rows(self) -> int:
+        """Rows the similarity ops may surface: the base vocab plus
+        every assigned extra row. This bound enters the warmed top-k
+        programs as a TRACED scalar, so growing (or freeing) rows never
+        costs a compile — the streaming hot-swap contract (ISSUE 10)."""
+        return self.vocab_size + self.extra_rows_assigned
+
+    def _extra_row_init(self, start: int, m: int) -> jax.Array:
+        """Fresh syn0 init for rows ``[start, start+m)``: the word2vec
+        ``U[-0.5/d, 0.5/d)`` draw, keyed per GLOBAL row by the engine
+        seed — so batched and single assignment produce identical
+        values and repeated runs draw identically. Compiled once per
+        block size ``m``; ``start`` is traced."""
+        if not hasattr(self, "_extra_init_fn"):
+            d = self.dim
+            base = jax.random.PRNGKey(self._seed)
+
+            def _block(start, rel):
+                keys = jax.vmap(
+                    lambda r: jax.random.fold_in(base, (1 << 30) + r)
+                )(start + rel)
+                blk = jax.vmap(
+                    lambda k: jax.random.uniform(
+                        k, (self.padded_dim,), jnp.float32,
+                        minval=-0.5 / d, maxval=0.5 / d,
+                    )
+                )(keys)
+                if self.padded_dim > d:
+                    blk = blk.at[:, d:].set(0.0)
+                return blk
+
+            self._extra_init_fn = jax.jit(_block)
+        return self._extra_init_fn(
+            jnp.int32(start), jnp.arange(m, dtype=jnp.int32)
+        )
+
+    def assign_extra_rows(self, words: Sequence[Optional[str]]) -> List[int]:
+        """Claim ``len(words)`` consecutive spare extra rows in one
+        batched mutation: the promotion-burst path (a vocabulary shift
+        can promote thousands of words between two mini-epochs, and
+        per-word writes would issue thousands of serialized single-row
+        dispatches). The block is written in power-of-two chunks, so a
+        lifetime of arbitrary burst sizes compiles at most
+        ``log2(extra_rows_total)`` distinct block shapes, and the whole
+        burst costs ONE ``table_version`` tick.
+
+        Each claimed syn0 row gets the word2vec ``U[-0.5/d, 0.5/d)``
+        init keyed by the engine seed + its GLOBAL row (identical to n
+        single assignments — the draw does not depend on the batch it
+        arrived in) and the syn1 row is zeroed, so a
+        freed-and-recycled row never leaks its previous word's trained
+        values. Returns the claimed GLOBAL row indices — always the
+        next ``len(words)`` rows after ``queryable_rows``, so the
+        caller's grown word list stays aligned with the table by
+        construction. ``words`` feed the obs event only — the engine
+        stays word-agnostic; the vocabulary layer owns the mapping."""
+        words = list(words)
+        n = len(words)
+        if n == 0:
+            return []
+        if n > self.extra_rows_free:
+            raise ValueError(
+                f"no spare extra rows left for {n} word(s) "
+                f"({self.extra_rows_assigned}/{self.extra_rows_total} "
+                "assigned); construct the engine with more extra_rows "
+                "headroom"
+            )
+        start = self.vocab_size + self.extra_rows_assigned
+        fn = self._row_writer()
+        s, left = start, n
+        while left:
+            m = 1 << (left.bit_length() - 1)
+            self.syn0 = fn(
+                self.syn0, self._extra_row_init(s, m), jnp.int32(s)
+            )
+            self.syn1 = fn(
+                self.syn1, jnp.zeros((m, self.padded_dim), jnp.float32),
+                jnp.int32(s),
+            )
+            s += m
+            left -= m
+        self.extra_rows_assigned += n
+        self._tick_tables("assign_extra_row")
+        obs_events.emit(
+            "extra_rows_assigned", start=start, n=n,
+            assigned=self.extra_rows_assigned, words=words[:8],
+        )
+        return list(range(start, start + n))
+
+    def assign_extra_row(self, word: Optional[str] = None) -> int:
+        """Claim the next spare extra row for a word that entered the
+        vocabulary at runtime (ISGNS online vocab growth). Returns the
+        claimed GLOBAL row index. Single-word form of
+        :meth:`assign_extra_rows` — identical init, one
+        ``table_version`` tick per call."""
+        return self.assign_extra_rows([word])[0]
+
+    def free_extra_rows(self, n: Optional[int] = None) -> int:
+        """Release the last ``n`` assigned extra rows (default: all),
+        zeroing both table rows so a later reassignment can never leak
+        the previous word's vectors. Returns the number freed. Ticks
+        ``table_version`` — the queryable bound shrank, so any cached
+        top-k that surfaced a freed row must drop."""
+        if n is None:
+            n = self.extra_rows_assigned
+        n = int(n)  # graftlint: ignore[sync-point] host argument, not a device value
+        if n < 0 or n > self.extra_rows_assigned:
+            raise ValueError(
+                f"cannot free {n} extra rows "
+                f"({self.extra_rows_assigned} assigned)"
+            )
+        if n == 0:
+            return 0
+        start = self.vocab_size + self.extra_rows_assigned - n
+        fn = self._row_writer()
+        zeros = jnp.zeros((n, self.padded_dim), jnp.float32)
+        self.syn0 = fn(self.syn0, zeros, jnp.int32(start))
+        self.syn1 = fn(self.syn1, zeros, jnp.int32(start))
+        self.extra_rows_assigned -= n
+        self._tick_tables("free_extra_rows")
+        obs_events.emit(
+            "extra_rows_freed", freed=n, assigned=self.extra_rows_assigned,
+        )
+        return n
+
+    def set_noise_counts(self, counts: np.ndarray) -> None:
+        """Install updated per-word corpus counts and rebuild the
+        negative-sampling alias table from them — the ISGNS adaptive
+        unigram distribution (arXiv:1704.03956): a long-lived streaming
+        trainer re-derives the noise distribution from the counts it
+        has actually observed, on a cadence, instead of freezing the
+        bootstrap distribution forever.
+
+        Shapes are invariant (``prob``/``alias`` stay ``(vocab_size,)``
+        arrays), so every compiled train program keeps running warm —
+        the refresh is two replicated device_puts. Spare extra rows are
+        never negative-sampled (the table spans the base vocab only, as
+        for fastText buckets); checkpoints carry the updated counts."""
+        # graftlint: ignore[sync-point] counts arrive as a host numpy array
+        c = np.asarray(counts, dtype=np.int64)
+        if c.shape != (self.vocab_size,):
+            raise ValueError(
+                f"counts must have shape ({self.vocab_size},), got {c.shape}"
+            )
+        if c.sum() <= 0:
+            raise ValueError("counts must sum to > 0")
+        table = build_unigram_alias(
+            c, power=self.unigram_power, table_size=self.unigram_table_size
+        )
+        self._counts = c.copy()
+        repl = NamedSharding(self.mesh, P())
+        self._prob = jax.device_put(jnp.asarray(table.prob), repl)
+        self._alias = jax.device_put(jnp.asarray(table.alias), repl)
+        obs_events.emit(
+            # graftlint: ignore[sync-point] c is the host counts array
+            "noise_counts_updated", train_words=int(c.sum()),
+        )
 
     def norms(self) -> jax.Array:
         """Per-row Euclidean norms of syn0, computed shard-local (Glint
@@ -1589,7 +1807,8 @@ class EmbeddingEngine:
             self._topk_cache[k_b] = self._make_topk(k_b)
         self._count_query_shape("topk", k_b)
         val, idx = self._topk_cache[k_b](
-            self.syn0, self._pad_query(v), self.norms()
+            self.syn0, self._pad_query(v), self.norms(),
+            jnp.int32(self.queryable_rows),
         )
         return np.asarray(val)[:k], np.asarray(idx)[:k]
 
@@ -1638,7 +1857,10 @@ class EmbeddingEngine:
                     [qc, np.zeros((q_b - n, qc.shape[1]), np.float32)]
                 )
             self._count_query_shape("topk_batch", q_b, k_b)
-            val, idx = fn(self.syn0, self._pad_query(qc), self.norms())
+            val, idx = fn(
+                self.syn0, self._pad_query(qc), self.norms(),
+                jnp.int32(self.queryable_rows),
+            )
             vals.append(np.asarray(val)[:n, :kk])
             idxs.append(np.asarray(idx)[:n, :kk])
         return np.concatenate(vals), np.concatenate(idxs)
@@ -1932,6 +2154,7 @@ class EmbeddingEngine:
             "unigram_power": self.unigram_power,
             "unigram_table_size": self.unigram_table_size,
             "extra_rows": self.num_rows - self.vocab_size,
+            "extra_rows_assigned": self.extra_rows_assigned,
             "dtype": (
                 "bfloat16" if self._dtype == jnp.bfloat16 else "float32"
             ),
@@ -2158,7 +2381,23 @@ class EmbeddingEngine:
         raises ``utils.integrity.CheckpointCorruptError`` on mismatch
         or a partial directory, so bit rot can never load silently.
         Legacy directories with no manifest load unverified;
-        ``GLINT_CKPT_NO_VERIFY=1`` downgrades to size-only checks."""
+        ``GLINT_CKPT_NO_VERIFY=1`` downgrades to size-only checks.
+
+        Implemented as :meth:`stage_tables` (disk reads + device
+        transfers, safe to run concurrently with live query dispatches)
+        followed by :meth:`adopt_tables` (the attribute flip + version
+        tick). The serving hot-swap path (ISSUE 10) calls the two
+        halves itself so a new table generation loads entirely OFF the
+        request path and the flip happens under the device lock."""
+        self.adopt_tables(self.stage_tables(path, verify=verify))
+
+    def stage_tables(self, path: str, *, verify: bool = True):
+        """Read a :meth:`save` directory and build the re-sharded device
+        arrays WITHOUT touching the engine's live state: no attribute is
+        assigned, no version ticked, and in-flight dispatches against
+        the current tables are unaffected. Returns an opaque staged
+        payload for :meth:`adopt_tables`. Raises exactly as
+        :meth:`load_tables` (geometry mismatch, integrity failure)."""
         if verify:
             from glint_word2vec_tpu.utils import integrity
 
@@ -2176,6 +2415,7 @@ class EmbeddingEngine:
             )
         fmt = meta.get("format", "single")
         tsh = self._table_sharding()
+        staged = {"meta": meta}
         for name in ("syn0", "syn1"):
             # Source blocks as (row range, col range, data), covering any
             # mix of row-block (rows layout), col-block (dims layout), or
@@ -2221,13 +2461,24 @@ class EmbeddingEngine:
                         ]
                 return out.astype(self._dtype)
 
-            setattr(
-                self,
-                name,
-                jax.make_array_from_callback(
-                    (self.padded_vocab, self.padded_dim), tsh, assemble
-                ),
+            staged[name] = jax.make_array_from_callback(
+                (self.padded_vocab, self.padded_dim), tsh, assemble
             )
+        return staged
+
+    def adopt_tables(self, staged) -> None:
+        """Flip the live tables to a :meth:`stage_tables` payload: two
+        attribute assignments, the assigned-extra-row count from the
+        snapshot's manifest, and ONE ``table_version`` tick (norms
+        cache + serving result caches drop). Microseconds — the whole
+        point of the split is that this is all the serving hot-swap
+        holds the device lock for."""
+        self.syn0 = staged["syn0"]
+        self.syn1 = staged["syn1"]
+        # graftlint: ignore[sync-point] meta is the parsed engine.json dict
+        self.extra_rows_assigned = int(
+            staged["meta"].get("extra_rows_assigned", 0)
+        )
         self._tick_tables("load_tables")
 
     def set_tables(self, syn0: np.ndarray, syn1: np.ndarray) -> None:

@@ -26,6 +26,22 @@ Endpoints (JSON in/out, stdlib-only server):
   POST /transform          {"sentences": [[w, ...], ...]}  (OOV dropped)
   POST /shutdown           stops the server (the terminateOtherClients
                            analogue: an explicit, remote, cross-client kill)
+  POST /reload             hot-swap the served tables to a published
+                           generation: {"dir": GEN_DIR} loads that
+                           directory; {} polls the --watch-checkpoint
+                           publish dir immediately
+
+Hot-swap (ISSUE 10): a :class:`SnapshotWatcher` polls a streaming
+trainer's publish directory (``LATEST.json``, streaming/publish.py) and
+flips each new generation into the live engine. Staging — disk reads,
+integrity verification, building the re-sharded device arrays — runs
+entirely OFF the request path (``EmbeddingEngine.stage_tables``); the
+flip itself (``adopt_tables`` + the vocabulary swap) happens under the
+device lock, so every in-flight dispatch drains against the tables it
+started with and no response ever mixes generations. The flip ticks
+``table_version``, emptying the synonym result cache wholesale, and the
+swapped tables have the same shapes as the old ones, so every warmed
+compiled program is reused — zero post-warmup compiles across swaps.
 
 Every device dispatch on the hot path belongs to a small, pre-warmed
 shape family: coalesced batches pad to power-of-two Q buckets (capped at
@@ -48,8 +64,10 @@ Start from the CLI:  glint-word2vec-tpu serve --model DIR --port 8801
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
+import os
 import sys
 import threading
 import time
@@ -435,6 +453,80 @@ class _SynonymCoalescer:
                         self._cache[(r["word"], r["num"])] = r["result"]
 
 
+class SnapshotWatcher:
+    """Background poller that follows a publish directory's
+    ``LATEST.json`` pointer (streaming/publish.py) and hot-swaps each
+    new generation into the live server.
+
+    The pointer is only ever flipped AFTER a generation's atomic
+    commit, so the watcher can never observe a partial snapshot — and
+    staging verifies the matrix manifest besides, so a corrupt
+    generation is a counted ``swap_failure`` (the previous tables stay
+    live), never a bad serve. A failed generation is not retried until
+    the pointer moves again."""
+
+    def __init__(self, server: "ModelServer", watch_dir: str,
+                 poll_seconds: float = 1.0):
+        self.server = server
+        self.watch_dir = watch_dir
+        self.poll_seconds = max(0.05, float(poll_seconds))
+        #: Generation name currently served (watcher-thread written;
+        #: /reload reads it for its "unchanged" answer — a stale read
+        #: only costs one redundant poll).
+        self.current: Optional[str] = None
+        #: Last generation that failed staging — not retried until the
+        #: pointer names a different one.
+        self._failed: Optional[str] = None
+        #: Serializes polls between the watcher thread and POST
+        #: /reload request threads: without it both could stage the
+        #: same generation (duplicate disk reads + device transfers)
+        #: and adopt it twice, double-counting table_swaps.
+        self._poll_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> Optional[str]:
+        """One pointer check; returns the generation name when a swap
+        happened, else None. Never raises — failures are logged and
+        counted on the serving metrics."""
+        with self._poll_mu:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> Optional[str]:
+        from glint_word2vec_tpu.streaming.publish import read_latest
+
+        latest = read_latest(self.watch_dir)
+        if latest is None:
+            return None
+        gen = str(latest["generation"])
+        if gen == self.current or gen == self._failed:
+            return None
+        gen_dir = os.path.join(self.watch_dir, gen)
+        try:
+            self.server.reload_generation(gen_dir, generation=gen)
+        except Exception as e:
+            logger.error("hot-swap of %s failed: %s", gen, e)
+            self.server.metrics.record_swap(gen, ok=False)
+            self._failed = gen
+            return None
+        self.current = gen
+        self._failed = None
+        return gen
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="glint-snapshot-watcher"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_seconds):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 class ModelServer:
     """Holds one loaded model and serves its query surface over HTTP.
 
@@ -642,6 +734,54 @@ class ModelServer:
                     finally:
                         server._release_slot()
                 out = None
+                if path == "/reload":
+                    # Admin hot-swap: explicit generation dir, or an
+                    # immediate poll of the watched publish dir. Not a
+                    # _DEVICE_PATHS member — an overloaded server must
+                    # still be swappable (staging runs lock-free; the
+                    # flip queues behind in-flight dispatches only).
+                    if "dir" in req:
+                        gen_dir = str(req["dir"])
+                        gen = req.get("generation") or os.path.basename(
+                            os.path.normpath(gen_dir)
+                        )
+                        # Serialize against the watcher's poll thread —
+                        # an explicit reload racing a pointer poll must
+                        # not stage/adopt the same generation twice.
+                        mu = (
+                            server.watcher._poll_mu
+                            if server.watcher is not None
+                            else contextlib.nullcontext()
+                        )
+                        with mu:
+                            try:
+                                server.reload_generation(
+                                    gen_dir, generation=gen
+                                )
+                            except Exception as e:
+                                server.metrics.record_swap(gen, ok=False)
+                                return self._send(400, {"error": str(e)})
+                            if server.watcher is not None:
+                                server.watcher.current = gen
+                        return self._send(
+                            200, {"status": "reloaded", "generation": gen}
+                        )
+                    if server.watcher is None:
+                        return self._send(
+                            400,
+                            {"error": "no --watch-checkpoint dir "
+                                      'configured; pass {"dir": ...}'},
+                        )
+                    gen = server.watcher.poll_once()
+                    if gen is None:
+                        return self._send(
+                            200,
+                            {"status": "unchanged",
+                             "generation": server.watcher.current},
+                        )
+                    return self._send(
+                        200, {"status": "reloaded", "generation": gen}
+                    )
                 if path == "/shutdown":
                     with server._lock:
                         out = server._dispatch(path, req)
@@ -739,6 +879,67 @@ class ModelServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        self.watcher: Optional[SnapshotWatcher] = None
+
+    # -- hot-swap (ISSUE 10) ------------------------------------------
+
+    def watch(self, watch_dir: str, poll_seconds: float = 1.0,
+              current: Optional[str] = None) -> SnapshotWatcher:
+        """Follow a publish directory: every new committed generation
+        is staged off the request path and flipped in atomically.
+        ``current`` names the generation already loaded at startup so
+        the first poll doesn't re-load it."""
+        self.watcher = SnapshotWatcher(self, watch_dir, poll_seconds)
+        self.watcher.current = current
+        if current is not None:
+            self.metrics.generation = current
+        self.watcher.start()
+        logger.info(
+            "watching %s for published generations (poll %.2fs)",
+            watch_dir, poll_seconds,
+        )
+        return self.watcher
+
+    def reload_generation(self, gen_dir: str,
+                          generation: Optional[str] = None) -> None:
+        """Hot-swap the served tables to a committed generation
+        directory (a model dir: ``matrix/`` + ``words.txt``).
+
+        Staging — manifest verification, disk reads, building the
+        re-sharded device arrays — runs on the calling thread with NO
+        lock held, concurrent with live dispatches against the old
+        tables. The flip is two attribute assignments + one
+        ``table_version`` tick under the device lock: in-flight
+        dispatches drain first (no response mixes generations), the
+        synonym result cache empties wholesale, and the same-shape
+        tables reuse every warmed compiled program (zero post-warmup
+        compiles — the PR 2 contract, preserved across swaps)."""
+        from glint_word2vec_tpu.corpus.vocab import saved_model_vocabulary
+        from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+        if type(self.model) is not Word2VecModel:
+            raise ValueError(
+                f"hot-swap supports the base word-level family only "
+                f"(serving a {type(self.model).__name__})"
+            )
+        engine = self.model.engine
+        staged = engine.stage_tables(os.path.join(gen_dir, "matrix"))
+        meta = staged["meta"]
+        vocab = saved_model_vocabulary(
+            gen_dir,
+            np.load(os.path.join(gen_dir, "matrix", "counts.npy")),
+            int(meta["vocab_size"]) + int(
+                meta.get("extra_rows_assigned", 0)
+            ),
+        )
+        with self._lock:
+            engine.adopt_tables(staged)
+            self.model.vocab = vocab
+        self.metrics.record_swap(generation, ok=True)
+        logger.info(
+            "hot-swapped to %s (%d words, table_version %d)",
+            generation or gen_dir, len(vocab.words), engine.table_version,
+        )
 
     # -- overload protection ------------------------------------------
 
@@ -885,6 +1086,8 @@ class ModelServer:
         self._thread.start()
 
     def stop(self) -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._prev_switch is not None:
@@ -893,7 +1096,7 @@ class ModelServer:
 
 
 def serve_model_dir(
-    model_dir: str,
+    model_dir: Optional[str],
     host: str = "127.0.0.1",
     port: int = 8801,
     *,
@@ -903,16 +1106,73 @@ def serve_model_dir(
     max_inflight: int = 256,
     request_deadline: Optional[float] = 30.0,
     degraded_after: Optional[float] = 5.0,
+    watch_dir: Optional[str] = None,
+    watch_poll: float = 1.0,
 ) -> None:
-    """Load a saved model (any family) and serve it until killed."""
+    """Load a saved model (any family) and serve it until killed.
+
+    ``watch_dir`` follows a streaming trainer's publish directory:
+    ``model_dir=None`` then boots from its newest committed generation
+    (waiting for the first one to appear), and every later generation
+    hot-swaps in under load."""
     from glint_word2vec_tpu import load_model
 
+    current = None
+    model = None
+    if model_dir is None:
+        if watch_dir is None:
+            raise ValueError("model_dir or watch_dir required")
+        from glint_word2vec_tpu.streaming.publish import resolve_latest
+
+        while True:
+            gen_dir = resolve_latest(watch_dir)
+            if gen_dir is None:
+                logger.info(
+                    "waiting for a first committed generation in %s",
+                    watch_dir,
+                )
+                time.sleep(max(0.05, watch_poll))
+                continue
+            try:
+                model = load_model(gen_dir)
+            except Exception as e:
+                # Retention can prune this generation while we read it
+                # (a fast publish cadence and a slow cold load): chase
+                # the pointer instead of dying at boot. An unchanged
+                # pointer to a still-present dir is real corruption.
+                if (
+                    resolve_latest(watch_dir) != gen_dir
+                    or not os.path.isdir(gen_dir)
+                ):
+                    logger.warning(
+                        "boot load of %s failed (%s) — generation "
+                        "pruned mid-read; chasing the pointer",
+                        gen_dir, e,
+                    )
+                    time.sleep(max(0.05, watch_poll))
+                    continue
+                raise
+            model_dir = gen_dir
+            current = os.path.basename(gen_dir)
+            break
+    elif watch_dir is not None:
+        # An explicit --model that names a generation inside the
+        # watched dir is already loaded: seed the watcher with it so
+        # the first poll doesn't redundantly re-stage and hot-swap the
+        # very tables being served (spurious swap count + cache flush).
+        md = os.path.abspath(model_dir)
+        if os.path.dirname(md) == os.path.abspath(watch_dir):
+            current = os.path.basename(md)
+    if model is None:
+        model = load_model(model_dir)
     server = ModelServer(
-        load_model(model_dir), host=host, port=port,
+        model, host=host, port=port,
         max_batch=max_batch, warmup=warmup, cache_size=cache_size,
         max_inflight=max_inflight, request_deadline=request_deadline,
         degraded_after=degraded_after,
     )
+    if watch_dir is not None:
+        server.watch(watch_dir, poll_seconds=watch_poll, current=current)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

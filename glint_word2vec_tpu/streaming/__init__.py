@@ -1,0 +1,41 @@
+"""Streaming ISGNS (ISSUE 10): incremental training on an unbounded
+sentence stream, publishing model generations a serving fleet hot-swaps
+under live traffic.
+
+Three pieces close the trainer->server loop:
+
+- :mod:`glint_word2vec_tpu.corpus.stream_vocab` — the online vocabulary
+  (exact live counts + space-saving candidate sketch + promotion onto
+  the engine's spare extra rows, arXiv:1704.03956).
+- :mod:`glint_word2vec_tpu.streaming.publish` — the generation commit
+  protocol: ``gen-NNNNNN`` model directories committed by one atomic
+  rename, referenced by an atomically-flipped ``LATEST.json`` pointer,
+  so a watcher can never observe a partial snapshot.
+- :mod:`glint_word2vec_tpu.streaming.trainer` — the long-lived
+  ``fit_stream`` loop: bounded mini-epochs through the engine's packed
+  device-corpus path, adaptive distribution refresh, online vocab
+  growth, cadence publishing, and stream gauges on the obs stack.
+
+The serving half (snapshot watcher + ``/reload`` + the drained atomic
+table flip) lives in :mod:`glint_word2vec_tpu.serving`.
+"""
+
+from glint_word2vec_tpu.streaming.publish import (
+    LATEST_NAME,
+    SnapshotPublisher,
+    generation_name,
+    next_generation_seq,
+    read_latest,
+    resolve_latest,
+)
+from glint_word2vec_tpu.streaming.trainer import StreamTrainer
+
+__all__ = [
+    "LATEST_NAME",
+    "SnapshotPublisher",
+    "StreamTrainer",
+    "generation_name",
+    "next_generation_seq",
+    "read_latest",
+    "resolve_latest",
+]

@@ -70,6 +70,10 @@ POINTS = {
         "once per assembled batch group (producer thread)",
     "serving.dispatch":
         "once per coalesced/simple serving device dispatch",
+    "publish.pre_commit":
+        "just before a published generation directory's atomic rename",
+    "publish.pre_pointer":
+        "between the generation rename and the LATEST pointer flip",
 }
 
 _ACTIONS = ("exc", "kill", "hang", "delay")

@@ -388,6 +388,15 @@ class ServingMetrics:
         self.deadline_hits = 0
         self.degraded_entered = 0
         self.inflight_peak = 0
+        # Hot-swap accounting (ISSUE 10): table generations flipped
+        # into the live engine by the snapshot watcher / /reload.
+        self.table_swaps = 0
+        self.swap_failures = 0
+        self.last_swap_time: Optional[float] = None
+        #: Name of the generation currently served (None until the
+        #: first swap names one — a freshly-loaded model predates the
+        #: publish protocol's naming).
+        self.generation: Optional[str] = None
 
     #: Cap on distinct tracked endpoint paths: the key is the raw
     #: client-supplied request path, and without a bound a port scanner
@@ -449,6 +458,20 @@ class ServingMetrics:
             if n > self.inflight_peak:
                 self.inflight_peak = n
 
+    def record_swap(self, generation: Optional[str] = None,
+                    ok: bool = True) -> None:
+        """One hot-swap attempt: ``ok`` flips the live generation,
+        failure means the previous tables stayed live (staging or
+        verification rejected the candidate)."""
+        with self._mu:
+            if ok:
+                self.table_swaps += 1
+                self.last_swap_time = time.time()
+                if generation is not None:
+                    self.generation = generation
+            else:
+                self.swap_failures += 1
+
     def snapshot(self, total_compiles: int = 0,
                  checkpoint: Optional[dict] = None) -> dict:
         """``checkpoint`` is the engine's ``checkpoint_stats()`` dict
@@ -492,6 +515,15 @@ class ServingMetrics:
                     "warmup": int(self.warmup_compiles),
                     "post_warmup": int(total_compiles)
                     - int(self.warmup_compiles),
+                },
+                "hot_swap": {
+                    "table_swaps_total": self.table_swaps,
+                    "swap_failures_total": self.swap_failures,
+                    "last_swap_age_seconds": (
+                        round(time.time() - self.last_swap_time, 2)
+                        if self.last_swap_time else None
+                    ),
+                    "generation": self.generation,
                 },
                 "checkpoint": {
                     "pending_async_saves": (checkpoint or {}).get(

@@ -87,3 +87,26 @@ def _make_tiny_corpus():
 @pytest.fixture(scope="session")
 def tiny_corpus():
     return _make_tiny_corpus()
+
+
+@pytest.fixture(scope="session")
+def e2e_model(tiny_corpus):
+    """One 6-epoch reference training shared by every module that only
+    reads it (test_model_e2e, test_eval trained config-identical models
+    per module before — ~30s each on this container)."""
+    from glint_word2vec_tpu import Word2Vec
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    m = (
+        Word2Vec(mesh=make_mesh(2, 4))
+        .set_vector_size(48)
+        .set_window_size(5)
+        .set_step_size(0.025)
+        .set_batch_size(256)
+        .set_num_negatives(5)
+        .set_min_count(5)
+        .set_num_iterations(6)
+        .set_seed(1)
+    ).fit(tiny_corpus)
+    yield m
+    m.stop()

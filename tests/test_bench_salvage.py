@@ -6,6 +6,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 spec = importlib.util.spec_from_file_location(
@@ -101,6 +103,7 @@ def test_trace_summarize_op_classes():
         assert ts.classify(name) == want, (name, ts.classify(name))
 
 
+@pytest.mark.slow  # the tensorflow import alone costs ~20s of tier-1 wall
 def test_trace_summarize_device_plane_aggregation(tmp_path):
     # Synthetic xplane with the TPU trace shape: a device plane carrying
     # an "XLA Ops" line (must aggregate) plus spanning lines that must be
@@ -109,8 +112,6 @@ def test_trace_summarize_device_plane_aggregation(tmp_path):
     # kept out by the module|step|traceme exclusion — plus a host plane
     # (ignored). Counting any spanning line would double the device time.
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-    import pytest
-
     pytest.importorskip("tensorflow")
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 

@@ -114,6 +114,9 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path, tiny_corpus):
     from glint_word2vec_tpu import Word2Vec
     from glint_word2vec_tpu.parallel.mesh import make_mesh
 
+    # The resume-parity property is corpus-size independent; a slice
+    # keeps all four fits cheap while every gate word stays >= min_count.
+    tiny_corpus = tiny_corpus[:1500]
     ckdir = str(tmp_path / "ck")
     common = dict(
         vector_size=16, min_count=5, batch_size=128, seed=3, num_iterations=2,

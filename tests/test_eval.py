@@ -6,29 +6,18 @@ synonym gates — the reference's hard-coded integration quality checks
 import numpy as np
 import pytest
 
-from glint_word2vec_tpu import Word2Vec
 from glint_word2vec_tpu.eval import (
     evaluate_analogies,
     evaluate_synonym_gate,
     parse_analogy_file,
 )
-from glint_word2vec_tpu.parallel.mesh import make_mesh
 
 
 @pytest.fixture(scope="module")
-def model(tiny_corpus):
-    m = (
-        Word2Vec(mesh=make_mesh(2, 4))
-        .set_vector_size(48)
-        .set_window_size(5)
-        .set_step_size(0.025)
-        .set_batch_size(256)
-        .set_min_count(5)
-        .set_num_iterations(6)
-        .set_seed(1)
-    ).fit(tiny_corpus)
-    yield m
-    m.stop()
+def model(e2e_model):
+    # Read-only in this module: shares the session-scoped reference
+    # training instead of refitting an identical config.
+    return e2e_model
 
 
 def test_parse_analogy_file(tmp_path):

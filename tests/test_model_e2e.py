@@ -17,21 +17,10 @@ from glint_word2vec_tpu.parallel.mesh import make_mesh
 
 
 @pytest.fixture(scope="module")
-def model(tiny_corpus):
-    w2v = (
-        Word2Vec(mesh=make_mesh(2, 4))
-        .set_vector_size(48)
-        .set_window_size(5)
-        .set_step_size(0.025)
-        .set_batch_size(256)
-        .set_num_negatives(5)
-        .set_min_count(5)
-        .set_num_iterations(6)
-        .set_seed(1)
-    )
-    m = w2v.fit(tiny_corpus)
-    yield m
-    m.stop()
+def model(e2e_model):
+    # Read-only in this module: shares the session-scoped reference
+    # training instead of refitting an identical config.
+    return e2e_model
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,559 @@
+"""Streaming trainer tests (ISSUE 10): engine runtime vocab growth,
+adaptive distribution refresh, the bounded mini-epoch fit_stream loop,
+and the generation publish protocol's crash safety."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu import Word2Vec, load_model
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.streaming.publish import (
+    LATEST_NAME,
+    SnapshotPublisher,
+    generation_name,
+    next_generation_seq,
+    read_latest,
+    resolve_latest,
+)
+from glint_word2vec_tpu.utils import faults
+
+
+def _engine(extra_rows=4, vocab=8, dim=8, mesh=None):
+    counts = np.arange(vocab, 0, -1, dtype=np.int64) * 10
+    return EmbeddingEngine(
+        mesh or make_mesh(1, 1), vocab, dim, counts, num_negatives=2,
+        seed=3, extra_rows=extra_rows,
+    )
+
+
+# ----------------------------------------------------------------------
+# Engine growth API (satellite: assign_extra_row / free_extra_rows)
+# ----------------------------------------------------------------------
+
+
+def test_assign_extra_row_sequential_and_bounded():
+    eng = _engine(extra_rows=2)
+    assert (eng.extra_rows_total, eng.extra_rows_free) == (2, 2)
+    assert eng.queryable_rows == eng.vocab_size
+    v0 = eng.table_version
+    r0 = eng.assign_extra_row("new0")
+    r1 = eng.assign_extra_row("new1")
+    assert (r0, r1) == (eng.vocab_size, eng.vocab_size + 1)
+    assert eng.extra_rows_free == 0
+    assert eng.queryable_rows == eng.vocab_size + 2
+    assert eng.table_version == v0 + 2  # every assignment ticks
+    with pytest.raises(ValueError, match="no spare extra rows"):
+        eng.assign_extra_row("new2")
+
+
+def test_assign_extra_row_initializes_and_free_zeroes():
+    eng = _engine(extra_rows=2)
+    row = eng.assign_extra_row("w")
+    r = np.asarray(eng.pull(np.array([row], np.int32)))[0]
+    assert np.abs(r).max() > 0  # fresh U[-0.5/d, 0.5/d) init
+    assert np.abs(r).max() <= 0.5 / eng.dim + 1e-6
+    # Deterministic: a second engine draws the same init for the row.
+    eng2 = _engine(extra_rows=2)
+    eng2.assign_extra_row("w")
+    np.testing.assert_array_equal(
+        r, np.asarray(eng2.pull(np.array([row], np.int32)))[0]
+    )
+    v = eng.table_version
+    assert eng.free_extra_rows() == 1
+    assert eng.extra_rows_free == 2
+    assert eng.table_version == v + 1
+    # The freed row is zeroed — a later reassignment can't leak values.
+    gone = np.asarray(eng.pull(np.array([row], np.int32)))[0]
+    assert np.abs(gone).max() == 0
+    assert eng.free_extra_rows() == 0  # nothing assigned: no-op, no tick
+    with pytest.raises(ValueError):
+        eng.free_extra_rows(1)
+
+
+def test_queryable_rows_widen_topk_without_recompile():
+    eng = _engine(extra_rows=2, vocab=6, dim=8)
+    q = np.ones(8, np.float32)
+    eng.top_k_cosine(q, 4)
+    compiles = eng.query_compiles
+    row = eng.assign_extra_row("grown")
+    # Make the grown row the best match by a mile.
+    eng.write_rows(row, np.asarray(100.0 * np.ones((1, 8)), np.float32))
+    _, idx = eng.top_k_cosine(q, 4)
+    assert row in idx.tolist()  # the widened mask surfaces it
+    assert eng.query_compiles == compiles  # traced bound: no new shape
+    eng.free_extra_rows()
+    _, idx = eng.top_k_cosine(q, 4)
+    assert row not in idx.tolist()  # mask narrowed again
+    assert eng.query_compiles == compiles
+
+
+def test_set_noise_counts_matches_constructor_distribution():
+    eng = _engine(vocab=8)
+    fresh = np.asarray(
+        [50, 1, 1, 1, 1, 1, 1, 1], dtype=np.int64
+    )
+    ref = _engine(vocab=8)
+    ref_table = __import__(
+        "glint_word2vec_tpu.corpus.alias", fromlist=["build_unigram_alias"]
+    ).build_unigram_alias(
+        fresh, power=eng.unigram_power, table_size=eng.unigram_table_size
+    )
+    eng.set_noise_counts(fresh)
+    np.testing.assert_array_equal(np.asarray(eng._prob), ref_table.prob)
+    np.testing.assert_array_equal(np.asarray(eng._alias), ref_table.alias)
+    np.testing.assert_array_equal(eng._counts, fresh)
+    with pytest.raises(ValueError):
+        eng.set_noise_counts(np.ones(3, np.int64))
+    with pytest.raises(ValueError):
+        eng.set_noise_counts(np.zeros(8, np.int64))
+
+
+def test_upload_corpus_n_valid_bounds():
+    eng = _engine()
+    ids = np.zeros(64, np.int32)
+    offs = np.array([0, 32, 64], np.int64)
+    with pytest.raises(ValueError, match="n_valid"):
+        eng.upload_corpus(ids, offs, n_valid=65)
+    eng.upload_corpus(ids, offs, n_valid=32)
+    assert eng._corpus_n_valid == 32
+    # Device subsampling over a bounded view is rejected (host-side
+    # subsampling is the streaming contract).
+    eng.set_keep_probs(np.ones(eng.vocab_size, np.float32))
+    with pytest.raises(ValueError, match="n_valid"):
+        eng.compact_corpus(__import__("jax").random.PRNGKey(0))
+
+
+# ----------------------------------------------------------------------
+# Publish protocol
+# ----------------------------------------------------------------------
+
+
+def _publish_one(tmp_path, eng=None, words=None):
+    eng = eng or _engine()
+    pub = SnapshotPublisher(
+        str(tmp_path), eng,
+        Word2Vec(vector_size=eng.dim).params, keep=3,
+    )
+
+    class _V:
+        pass
+
+    v = _V()
+    v.words = words or [f"w{i}" for i in range(eng.vocab_size)]
+    pub.publish(v)
+    eng.wait_pending_saves()
+    return pub, eng
+
+
+def test_publish_commit_and_pointer(tmp_path):
+    pub, eng = _publish_one(tmp_path)
+    latest = read_latest(str(tmp_path))
+    assert latest["generation"] == "gen-000001"
+    assert latest["table_version"] == eng.table_version
+    gen = resolve_latest(str(tmp_path))
+    assert gen.endswith("gen-000001")
+    for fname in ("words.txt", "params.json"):
+        assert os.path.exists(os.path.join(gen, fname))
+    assert os.path.exists(os.path.join(gen, "matrix", "manifest.json"))
+    assert not [e for e in os.listdir(tmp_path) if ".tmp-" in e]
+    # Sequence numbering resumes past committed generations.
+    assert next_generation_seq(str(tmp_path)) == 2
+    assert generation_name(2) == "gen-000002"
+
+
+def test_publish_retention_keeps_last_k(tmp_path):
+    eng = _engine()
+    pub = SnapshotPublisher(
+        str(tmp_path), eng, Word2Vec(vector_size=eng.dim).params, keep=2,
+    )
+
+    class _V:
+        words = [f"w{i}" for i in range(eng.vocab_size)]
+
+    for _ in range(4):
+        pub.publish(_V())
+    eng.wait_pending_saves()
+    gens = sorted(e for e in os.listdir(tmp_path) if e.startswith("gen-"))
+    assert gens == ["gen-000003", "gen-000004"]
+    assert read_latest(str(tmp_path))["generation"] == "gen-000004"
+
+
+def test_publish_crash_before_commit_leaves_pointer_untouched(tmp_path):
+    pub, eng = _publish_one(tmp_path)
+    faults.arm("publish.pre_commit:exc")
+    try:
+        class _V:
+            words = [f"w{i}" for i in range(eng.vocab_size)]
+
+        pub.publish(_V())
+        with pytest.raises(RuntimeError, match="checkpoint write failed"):
+            eng.wait_pending_saves()
+    finally:
+        faults.disarm()
+    # The pointer still names gen 1; the aborted gen 2 never committed.
+    assert read_latest(str(tmp_path))["generation"] == "gen-000001"
+    assert not any(
+        e.startswith("gen-000002") and ".tmp-" not in e
+        for e in os.listdir(tmp_path)
+    )
+    # A restarted publisher prunes the orphan temp dir and numbers on.
+    pub2 = SnapshotPublisher(
+        str(tmp_path), eng, Word2Vec(vector_size=eng.dim).params,
+    )
+    assert not [e for e in os.listdir(tmp_path) if ".tmp-" in e]
+    assert pub2._seq == 2
+
+
+def test_publish_crash_before_pointer_never_served(tmp_path):
+    """SIGKILL-equivalent between the generation rename and the LATEST
+    flip: the generation exists on disk, complete, but no watcher may
+    load it — and the next publisher numbers past it."""
+    pub, eng = _publish_one(tmp_path)
+    faults.arm("publish.pre_pointer:exc")
+    try:
+        class _V:
+            words = [f"w{i}" for i in range(eng.vocab_size)]
+
+        pub.publish(_V())
+        with pytest.raises(RuntimeError, match="checkpoint write failed"):
+            eng.wait_pending_saves()
+    finally:
+        faults.disarm()
+    assert os.path.isdir(os.path.join(tmp_path, "gen-000002"))  # orphaned
+    assert read_latest(str(tmp_path))["generation"] == "gen-000001"
+    assert resolve_latest(str(tmp_path)).endswith("gen-000001")
+    assert next_generation_seq(str(tmp_path)) == 3  # never reuses 2
+
+
+def test_read_latest_tolerates_garbage(tmp_path):
+    assert read_latest(str(tmp_path)) is None
+    with open(os.path.join(tmp_path, LATEST_NAME), "w") as f:
+        f.write("{not json")
+    assert read_latest(str(tmp_path)) is None
+    with open(os.path.join(tmp_path, LATEST_NAME), "w") as f:
+        json.dump({"generation": "gen-000077"}, f)
+    assert resolve_latest(str(tmp_path)) is None  # referenced dir missing
+
+
+# ----------------------------------------------------------------------
+# fit_stream end to end
+# ----------------------------------------------------------------------
+
+
+def _shift_stream(tiny_corpus, new_word="zagreb", repeats=2):
+    for s in tiny_corpus:
+        yield s
+    # The shifted phase spans several mini-epochs: a word promoted at
+    # round N's boundary starts ENCODING (and training) in round N+1 —
+    # the one-round promotion latency inherent to fill-then-promote.
+    for _ in range(3):
+        for s in tiny_corpus[:300]:
+            yield list(s) + [new_word] * repeats
+
+
+@pytest.fixture(scope="module")
+def streamed(tiny_corpus, tmp_path_factory):
+    pub_dir = str(tmp_path_factory.mktemp("publish"))
+    w2v = (
+        Word2Vec(mesh=make_mesh(1, 2))
+        .set_vector_size(32).set_window_size(3).set_step_size(0.025)
+        .set_batch_size(256).set_num_negatives(5).set_min_count(5)
+        .set_seed(1).set_steps_per_call(4)
+    )
+    model = w2v.fit_stream(
+        _shift_stream(tiny_corpus),
+        publish_dir=pub_dir,
+        bootstrap_words=2000, buffer_words=4096, extra_rows=8,
+        publish_seconds=1e9, publish_words=8000, promote_min_count=50,
+    )
+    yield model, pub_dir
+    model.stop()
+
+
+def test_fit_stream_grows_vocab_and_trains(streamed):
+    model, _ = streamed
+    tm = model.training_metrics
+    assert tm["pipeline"] == "stream"
+    assert tm["rounds"] >= 3
+    assert tm["promoted_words"] >= 1
+    assert "zagreb" in model.vocab.word_index
+    # The promoted word sits on an extra row, aligned by construction.
+    idx = model.vocab.word_index["zagreb"]
+    assert idx >= model.engine.vocab_size
+    assert model.engine.queryable_rows == model.vocab.size
+    # It trained: its vector moved off the deterministic fresh init.
+    import jax
+
+    d = model.engine.dim
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(model.engine._seed), (1 << 30) + idx
+    )
+    init = np.asarray(jax.random.uniform(
+        key, (1, model.engine.padded_dim), np.float32,
+        minval=-0.5 / d, maxval=0.5 / d,
+    ))[0, :d]
+    now = model.transform("zagreb")
+    assert np.abs(now - init).max() > 1e-6
+    # And it is queryable end to end.
+    syns = model.find_synonyms("zagreb", 3)
+    assert len(syns) == 3
+
+
+def test_fit_stream_counts_are_exact(streamed, tiny_corpus):
+    # Base-vocab counts after the run equal the exact stream counts:
+    # the bootstrap window is counted ONCE (by the bootstrap scan) and
+    # replayed encode-only — a double-counted bootstrap would skew the
+    # adaptive distributions and halve the promotion threshold.
+    import collections
+
+    model, _ = streamed
+    exact = collections.Counter()
+    for s in _shift_stream(tiny_corpus):
+        exact.update(s)
+    vocab = model.vocab
+    for w in ("austria", "vienna", "germany", "berlin"):
+        assert vocab.counts[vocab.word_index[w]] == exact[w], w
+
+
+def test_fit_stream_quality_on_streamed_corpus(streamed):
+    # The capitals structure must survive the streaming path (same
+    # gates as the batch smoke, looser bar: one pass, constant LR).
+    model, _ = streamed
+    syns = dict(model.find_synonyms("austria", 10))
+    assert "vienna" in syns
+
+
+def test_fit_stream_publishes_loadable_generations(streamed):
+    model, pub_dir = streamed
+    latest = read_latest(pub_dir)
+    assert latest is not None
+    gens = sorted(e for e in os.listdir(pub_dir) if e.startswith("gen-"))
+    assert latest["generation"] == gens[-1]
+    assert model.training_metrics["generations_published"] == int(
+        latest["seq"]
+    )
+    # The final generation reloads as a grown model: words.txt carries
+    # the promoted word and the matrix claims its assigned extra row.
+    loaded = load_model(resolve_latest(pub_dir))
+    assert loaded.vocab.size == model.vocab.size
+    assert "zagreb" in loaded.vocab.word_index
+    np.testing.assert_allclose(
+        loaded.transform("zagreb"), model.transform("zagreb"), rtol=1e-6
+    )
+    loaded.stop()
+
+
+def test_fit_stream_adapts_noise_distribution(streamed):
+    model, _ = streamed
+    # The refresh installed live counts: the engine's noise counts are
+    # no longer the bootstrap-window counts (the stream kept counting).
+    eng = model.engine
+    assert int(eng._counts.sum()) > 10_000  # far beyond the 2k bootstrap
+
+
+def test_fit_stream_bounded_run(tiny_corpus):
+    def forever():
+        while True:
+            for s in tiny_corpus:
+                yield s
+
+    model = (
+        Word2Vec(mesh=make_mesh(1, 1))
+        .set_vector_size(16).set_window_size(3).set_batch_size(128)
+        .set_min_count(5).set_seed(2).set_steps_per_call(2)
+    ).fit_stream(
+        forever(), bootstrap_words=1500, buffer_words=2048,
+        extra_rows=4, max_words=5000,
+    )
+    assert model.training_metrics["words_trained"] >= 5000
+    assert model.training_metrics["words_trained"] < 5000 + 2048 + 1
+    model.stop()
+
+
+def test_fit_stream_empty_stream_raises():
+    with pytest.raises(ValueError, match="empty stream"):
+        Word2Vec(mesh=make_mesh(1, 1)).fit_stream(iter([]))
+
+
+def test_fit_stream_idle_stream_honors_bounds_and_cadence(
+    tiny_corpus, tmp_path
+):
+    """A slow-then-idle stream must neither pin a bounded run inside
+    the fill loop nor starve the publish cadence: the trainer breaks
+    out with a PARTIAL buffer when a deadline fires (the source's
+    ``[]`` heartbeats hand control back while idle)."""
+    import time
+
+    def trickle():
+        for s in tiny_corpus[:400]:  # covers bootstrap + a bit more
+            yield s
+        while True:  # then silence: heartbeats only
+            yield []
+            time.sleep(0.01)
+
+    pub = str(tmp_path / "pub")
+    model = (
+        Word2Vec(mesh=make_mesh(1, 1))
+        .set_vector_size(16).set_window_size(3).set_batch_size(128)
+        .set_min_count(5).set_seed(2).set_steps_per_call(2)
+    ).fit_stream(
+        trickle(), publish_dir=pub, bootstrap_words=1500,
+        # Buffer far larger than the stream will ever deliver: only
+        # the in-fill deadline checks can end this run.
+        buffer_words=1 << 15, extra_rows=4,
+        publish_seconds=0.2, max_seconds=2.0,
+    )
+    tm = model.training_metrics
+    # Terminated despite the unbounded idle stream, trained the words
+    # that did arrive, and published them without ever filling the
+    # buffer (cadence publish mid-run + the final publish).
+    assert 0 < tm["words_trained"] < (1 << 15)
+    assert tm["generations_published"] >= 2
+    assert read_latest(pub) is not None
+    model.stop()
+
+
+def test_cli_stream_source_follow_holds_partial_lines(tmp_path):
+    """Follow mode must never tokenize a half-written trailing line:
+    the partial tail is held until its newline lands, and idle polls
+    yield ``[]`` heartbeats instead of blocking."""
+    from glint_word2vec_tpu.cli import _stream_sentences
+
+    path = tmp_path / "feed.txt"
+    path.write_text("vienna is nice\nza")
+    g = _stream_sentences(str(path), follow=True, lowercase=True)
+    assert next(g) == ["vienna", "is", "nice"]
+    # The dangling "za" is NOT yielded — just an idle heartbeat.
+    assert next(g) == []
+    with open(path, "a") as f:
+        f.write("greb rocks\n")
+    out = next(g)
+    while out == []:  # at most one more poll under scheduler jitter
+        out = next(g)
+    assert out == ["zagreb", "rocks"]
+    g.close()
+    # Non-follow mode flushes a final newline-less line at EOF.
+    path2 = tmp_path / "batch.txt"
+    path2.write_text("a b\nc d")
+    assert list(
+        _stream_sentences(str(path2), follow=False, lowercase=True)
+    ) == [["a", "b"], ["c", "d"]]
+
+
+def test_fit_stream_quiet_stream_publishes_trained_rounds(
+    tiny_corpus, tmp_path
+):
+    """Words trained before the stream went quiet must reach the fleet
+    within the publish cadence — not sit unpublished until new data or
+    EOF arrives. The source ends only after it SEES a committed
+    generation (or a generous timeout on regressed code)."""
+    import time
+
+    pub = str(tmp_path / "pub")
+    published_live = []
+
+    def source():
+        for s in tiny_corpus[:300]:
+            yield s
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if read_latest(pub) is not None:
+                published_live.append(True)
+                return
+            yield []
+            time.sleep(0.01)
+
+    model = (
+        Word2Vec(mesh=make_mesh(1, 1))
+        .set_vector_size(16).set_window_size(3).set_batch_size(128)
+        .set_min_count(5).set_seed(2).set_steps_per_call(2)
+        .set_max_sentence_length(64)
+    ).fit_stream(
+        source(), publish_dir=pub, bootstrap_words=500,
+        buffer_words=512, publish_seconds=0.3,
+    )
+    assert published_live, "stream went quiet and nothing was published"
+    assert model.training_metrics["generations_published"] >= 1
+    model.stop()
+
+
+def test_fit_stream_unbounded_idle_publish(tmp_path):
+    """An UNBOUNDED run (no max_words/max_seconds) whose stream goes
+    quiet right at a buffer boundary must still publish the trained
+    rounds within publish_seconds: the fill loop breaks out on the due
+    cadence even with an EMPTY buffer (it used to spin on heartbeats
+    forever, reaching the idle-publish branch only via a stop bound)."""
+    import time
+
+    words16 = [f"w{i}" for i in range(16)]
+    rng = np.random.default_rng(7)
+    pub = str(tmp_path / "pub")
+    published_live = []
+
+    def source():
+        # 8-word sentences over a closed 16-word vocabulary at
+        # min_count=1 / subsample 0: every sentence encodes to exactly
+        # 8 ids, so 64 sentences fill the 512-word buffer EXACTLY and
+        # the quiet phase starts with an empty buffer (a partial one
+        # would break out via the fill > 0 path and mask the bug).
+        for _ in range(64 + 128):  # bootstrap window + two full rounds
+            yield list(rng.choice(words16, size=8))
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline:
+            if read_latest(pub) is not None:
+                published_live.append(True)
+                return
+            yield []
+            time.sleep(0.01)
+
+    model = (
+        Word2Vec(mesh=make_mesh(1, 1))
+        .set_vector_size(16).set_window_size(3).set_batch_size(128)
+        .set_min_count(1).set_subsample_ratio(0.0).set_seed(2)
+        .set_steps_per_call(2).set_max_sentence_length(64)
+    ).fit_stream(
+        source(), publish_dir=pub, bootstrap_words=512,
+        buffer_words=512, publish_seconds=4.0,
+    )
+    assert published_live, "idle unbounded stream never published"
+    model.stop()
+
+
+def test_cli_stream_source_stdin_heartbeats_and_partial_lines(
+    monkeypatch,
+):
+    """The default ``--corpus -`` source must behave like follow mode:
+    [] heartbeats while the pipe is quiet (so --max-seconds and
+    --publish-every stay live), half-written lines held until their
+    newline, and a final newline-less line flushed at EOF."""
+    import io
+
+    from glint_word2vec_tpu.cli import _stream_sentences
+
+    r, w = os.pipe()
+    monkeypatch.setattr(
+        "sys.stdin", io.TextIOWrapper(os.fdopen(r, "rb"))
+    )
+    g = _stream_sentences("-", follow=False, lowercase=True)
+    # Quiet pipe: heartbeat, not a block.
+    assert next(g) == []
+    os.write(w, b"vienna is nice\nza")
+    out = next(g)
+    while out == []:
+        out = next(g)
+    assert out == ["vienna", "is", "nice"]
+    # The dangling "za" is held, not tokenized.
+    assert next(g) == []
+    os.write(w, b"greb rocks\n")
+    out = next(g)
+    while out == []:
+        out = next(g)
+    assert out == ["zagreb", "rocks"]
+    # EOF flushes a final newline-less line.
+    os.write(w, b"tail line")
+    os.close(w)
+    assert [s for s in g if s] == [["tail", "line"]]
