@@ -56,6 +56,12 @@ def _add_train(sub):
     p.add_argument("--shared-negatives", type=int, default=0,
                    help="shared noise-pool size per step "
                         "(0 = per-pair reference semantics)")
+    p.add_argument("--packing", choices=["dense", "grid"], default="dense",
+                   help="device-corpus dispatch shape: dense pair "
+                        "packing (default — valid pairs compacted into "
+                        "dense pair batches on device) or the legacy "
+                        "grid window batches (~43%% live lanes at "
+                        "window 5)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--checkpoint-every", type=int, default=1,
@@ -655,6 +661,7 @@ def _run(args) -> int:
             layout=args.layout,
             steps_per_call=args.steps_per_call,
             shared_negatives=args.shared_negatives,
+            batch_packing=args.packing,
         )
         obs = None
         if (args.status_port is not None or args.status_file

@@ -46,6 +46,36 @@ def context_width(window: int) -> int:
     return max(1, 2 * int(window) - 3)
 
 
+def packed_pair_batch(
+    batch_size: int, window: int, multiple: int = 1
+) -> int:
+    """Dense pair slots covering ~``batch_size`` center positions.
+
+    The packed scan consumes whole positions until the next one's pairs
+    would overflow the pair batch, so the EFFECTIVE synchronous batch
+    of one packed step is ``P / E[pairs per position]`` positions.
+    Sizing ``P`` as ``batch_size * context_width`` (the grid step's lane
+    count) silently trains a ~1/density larger synchronous batch than
+    the grid step — enough to cross the hot-row overshoot threshold on
+    small vocabularies (all of a frequent word's same-direction rank-1
+    updates in a step are computed from the same pre-step row, so their
+    sum scales with its per-step occurrence count). This rule instead
+    matches the grid step's position coverage: ``E[pairs/position] =
+    E[max(2b - 1, 0)]`` for the shrink draw ``b ~ U[0, W)`` =
+    ``(W-1)^2 / W`` (sentence-boundary clipping only lowers it, which
+    just makes a step cover slightly more positions). Floored at the
+    lane count (forward-progress guarantee of pack_window_pairs) and
+    rounded up to ``multiple`` (the data-axis size)."""
+    W = int(window)
+    exp_pairs = max((W - 1) ** 2 / W, 1.0)
+    P = max(
+        int(np.ceil(batch_size * exp_pairs)),
+        context_width(W),
+        int(multiple),
+    )
+    return -(-P // int(multiple)) * int(multiple)
+
+
 def window_offsets(window: int) -> np.ndarray:
     """The lane -> relative-offset map matching :func:`context_width`."""
     W = int(window)

@@ -36,6 +36,7 @@ from glint_word2vec_tpu.corpus.batching import (
     context_width,
     encode_sentences,
     group_batches,
+    packed_pair_batch,
 )
 from glint_word2vec_tpu.corpus.vocab import (
     Vocabulary,
@@ -282,14 +283,14 @@ class Word2Vec:
         return self._set(shared_negatives=v)
 
     def set_batch_packing(self, v: str) -> "Word2Vec":
-        """Device-corpus dispatch shape: "grid" (default, the reference's
-        (batch, context) window grids — ~43% live lanes at window 5) or
-        "dense" (valid (center, context) pairs prefix-sum-compacted into
-        dense fixed-shape pair batches on device before the update, so
-        ~every dispatched FLOP is a useful pair). Dense packing wins
-        whenever the window-shrink draw leaves the grid sparse — window
-        >= 5 with reasonably long sentences; see README "Dense pair
-        packing"."""
+        """Device-corpus dispatch shape: "dense" (the default — valid
+        (center, context) pairs prefix-sum-compacted into dense
+        fixed-shape pair batches on device before the update, so ~every
+        dispatched FLOP is a useful pair, and the shape the fused
+        Pallas megakernel accelerates) or "grid" (the legacy reference
+        (batch, context) window grids — ~43% live lanes at window 5 —
+        kept for A/B comparison and old mid-epoch grid checkpoints).
+        See README "Dense pair packing"."""
         return self._set(batch_packing=v)
 
     def set_observability(self, obs) -> "Word2Vec":
@@ -564,13 +565,18 @@ class Word2Vec:
             base_key = jax.random.PRNGKey(p.seed)
             step = 0
             start_epoch = 0
-            # Dense pair packing (set_batch_packing("dense")): dispatch
-            # prefix-sum-compacted pair batches instead of half-masked
-            # window grids. Pair slots per step = the grid step's lane
-            # count, so a packed dispatch costs the same nominal FLOPs as
-            # a grid dispatch while covering ~1/density more positions.
+            # Dense pair packing (the default): dispatch prefix-sum-
+            # compacted pair batches instead of half-masked window
+            # grids. Pair slots per step cover ~B center positions in
+            # EXPECTATION (corpus/batching.packed_pair_batch), so a
+            # packed step trains the same effective synchronous batch
+            # as a grid step — identical update dynamics/stability —
+            # while spending ~zero dispatched lanes on masked padding
+            # (each step is ~density x the grid step's FLOPs).
             packed = p.batch_packing == "dense"
-            pair_batch = B * context_width(p.window)
+            pair_batch = packed_pair_batch(
+                B, p.window, mesh.shape["data"]
+            )
             resume_position = 0
             # Grid-equivalent step counter: pins the packed path's
             # window-shrink draws to the position->draw mapping the grid
@@ -625,9 +631,10 @@ class Word2Vec:
                         "packing mode, or restart from an epoch-boundary "
                         "checkpoint"
                     )
-                resume_position = (
-                    int(state.get("position", 0)) if packed else 0
-                )
+                # position is 0 in every epoch-boundary state (both
+                # modes record it uniformly); a nonzero value already
+                # passed the same-mode check above.
+                resume_position = int(state.get("position", 0))
                 gstep = int(state.get("gstep", state["step"]))
                 resume_words = int(state.get("words_done", start_epoch * twc))
                 logger.info(
@@ -926,13 +933,15 @@ class Word2Vec:
                             state_path, ck_name,
                             epochs_completed=epoch + 1, step=step,
                             words_done=(epoch + 1) * twc,
-                            extra=(
-                                {
-                                    "position": 0, "gstep": gstep,
-                                    "batch_packing": "dense",
-                                }
-                                if packed else None
-                            ),
+                            # Uniform state record for BOTH dispatch
+                            # modes (the grid-only special case is
+                            # gone): epoch boundaries always carry
+                            # position 0, the grid-equivalent step
+                            # base, and the mode that wrote them.
+                            extra={
+                                "position": 0, "gstep": gstep,
+                                "batch_packing": p.batch_packing,
+                            },
                         ),
                     )
                 if stopping:
@@ -969,14 +978,17 @@ class Word2Vec:
         steptime = obs_run.steptime_totals()
         if steptime:
             model.training_metrics["steptime"] = steptime
+        model.training_metrics["batch_packing"] = p.batch_packing
         if packed and packed_slots:
             # Packed fill = live pairs / dispatched pair slots — the
             # effective mask density of the packed dispatches (the grid
             # path runs ~0.43 at window 5; the CI smoke job gates >= 0.9).
             model.training_metrics.update(
-                batch_packing="dense",
                 packed_pairs=packed_pairs,
                 packed_mask_density=round(packed_pairs / packed_slots, 4),
+                # Whether the dispatches rode the fused Pallas megakernel
+                # (ops/pallas_sgns) instead of the composed XLA pair step.
+                pallas_fused=bool(getattr(engine, "_pallas_fused", False)),
             )
         return model
 
@@ -1049,11 +1061,15 @@ class Word2Vec:
         p = self.params
         pc = jax.process_count()
         if p.batch_packing == "dense":
-            logger.warning(
-                "batch_packing='dense' applies only to the device-resident "
-                "corpus path; this run routed to the host batcher "
-                "(multi-process, HBM budget, or GLINT_HOST_BATCHER) and "
-                "trains with grid-shaped batches"
+            # Dense packing is the default but applies only to the
+            # device-resident corpus path; host-batcher routes
+            # (multi-process, HBM budget, GLINT_HOST_BATCHER, subword
+            # grouping) always build grid-shaped batches. One info line,
+            # not a warning — the default config lands here legitimately.
+            logger.info(
+                "host-batcher route: training with grid-shaped batches "
+                "(dense pair packing applies to the device-resident "
+                "corpus path only)"
             )
         logger.info(
             "vocab: %d words, %d train words", vocab.size, vocab.train_words_count

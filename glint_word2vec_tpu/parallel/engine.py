@@ -115,6 +115,50 @@ def _pull_rows(table_l, idx, start, rows_per_shard, pallas_mode=0):
     return lax.psum(rows, MODEL_AXIS)
 
 
+def _dup_sum_f32(idx, upd):
+    """Collapse duplicate target rows to ONE fp32-summed update row per
+    id run (the remaining duplicate slots carry exact zeros), so a
+    low-precision table's scatter-add rounds each row's BATCH TOTAL
+    once instead of once per duplicate — the XLA restatement of the
+    fused kernel's fp32 VMEM run accumulation (ops/pallas_sgns), used
+    by :func:`_bf16_safe_scatter_add` whenever storage is narrower than
+    fp32. Without it the dense pair form is quality-lossy on bf16
+    tables: a center's per-context d_center contributions (summed in
+    the fp32 einsum under the grid shape) would each round against the
+    table separately, and sub-ulp contributions vanish entirely (the
+    dense+bf16 quality regression pinned in tests/test_pallas_sgns.py).
+
+    Sorted-run form: sort ids (duplicates become adjacent), fp32
+    inclusive cumsum over the sorted updates, per-run total = cum at
+    the run end minus cum just before the run start."""
+    N = idx.shape[0]
+    sid, order = lax.sort_key_val(
+        idx.astype(jnp.int32), jnp.arange(N, dtype=jnp.int32)
+    )
+    su = upd[order].astype(jnp.float32)
+    cum = jnp.cumsum(su, axis=0)
+    change = sid[1:] != sid[:-1]
+    is_start = jnp.concatenate([jnp.ones(1, bool), change])
+    is_end = jnp.concatenate([change, jnp.ones(1, bool)])
+    pos = jnp.arange(N, dtype=jnp.int32)
+    run_start = lax.cummax(jnp.where(is_start, pos, 0))
+    prev_cum = jnp.where(
+        (run_start > 0)[:, None], cum[jnp.maximum(run_start - 1, 0)], 0.0
+    )
+    return sid, jnp.where(is_end[:, None], cum - prev_cum, 0.0)
+
+
+def _bf16_safe_scatter_add(table_l, idx, upd):
+    """``table_l.at[idx].add(upd)`` with fp32 duplicate-row sums when
+    the table stores less than fp32 (see :func:`_dup_sum_f32`); the
+    fp32 path keeps the plain scatter-add (exactness-tested numerics,
+    no extra sort/cumsum work)."""
+    if jnp.dtype(table_l.dtype).itemsize >= 4:
+        return table_l.at[idx].add(upd.astype(table_l.dtype))
+    sid, summed = _dup_sum_f32(idx, upd)
+    return table_l.at[sid].add(summed.astype(table_l.dtype))
+
+
 def _scatter_rows(table_l, idx, upd, start, rows_per_shard, pallas_mode=0):
     """Apply global rank-1 updates to the owned slice of a sharded table
     (the servers' half of ``adjust``, SURVEY.md §2.2). Disowned updates are
@@ -127,15 +171,47 @@ def _scatter_rows(table_l, idx, upd, start, rows_per_shard, pallas_mode=0):
     if pallas_mode:
         from glint_word2vec_tpu.ops.pallas_rows import scatter_add_rows
 
+        if jnp.dtype(table_l.dtype).itemsize < 4:
+            # The pallas_rows run accumulator is TABLE dtype; pre-sum
+            # duplicate rows in fp32 so low-precision storage still
+            # rounds each row's batch total once (same contract as the
+            # XLA branch below and the fused kernels).
+            clipped, upd = _dup_sum_f32(clipped, upd)
         return scatter_add_rows(
             table_l, clipped, upd, interpret=pallas_mode == 2
         )
-    return table_l.at[clipped].add(upd.astype(table_l.dtype))
+    return _bf16_safe_scatter_add(table_l, clipped, upd)
 
 
 #: VMEM budget for pinning h_g whole in the fused rank-1 scatter kernel
 #: (ops/pallas_rows.scatter_add_rank1): ~16 MB/core minus block buffers.
 _RANK1_FUSE_VMEM_BYTES = 10_000_000
+
+#: Process-wide memo of the jitted corpus-scan programs, keyed by every
+#: engine attribute their closures capture (:meth:`EmbeddingEngine
+#:._scan_memo_key`) plus the scan shape. Short-lived engines with
+#: identical configuration — test suites, notebooks, repeated small
+#: fits — otherwise recompile the identical XLA program per engine
+#: (each engine's fresh ``jax.jit`` closures cannot share an in-memory
+#: jit cache), and the packed scan's program is the most expensive
+#: compile in the repo. Plain python-level reuse of the jit objects:
+#: every input that differs between engines (tables, noise tables,
+#: corpus buffers, scalars) is a traced ARGUMENT, so a memo hit is the
+#: same program by construction. The memo holds each entry's BUILDER
+#: engine alive via the jit closures (and with it that engine's
+#: current table pair, unless ``destroy()`` ran) — so it is BOUNDED:
+#: insertion past ``_SCAN_MEMO_MAX`` evicts the oldest entry, keeping
+#: the worst-case retention a fixed number of table pairs instead of
+#: one per distinct config ever seen by the process.
+_SCAN_MEMO: "dict" = {}
+_SCAN_MEMO_MAX = 32
+
+
+def _scan_memo_put(key, fn):
+    while len(_SCAN_MEMO) >= _SCAN_MEMO_MAX:
+        _SCAN_MEMO.pop(next(iter(_SCAN_MEMO)))
+    _SCAN_MEMO[key] = fn
+    return fn
 
 #: Floor of the top-k k-bucket family. Requested k is rounded up to
 #: ``max(next_pow2(k), TOPK_MIN_K_BUCKET)`` (capped at padded_vocab) and
@@ -180,8 +256,13 @@ def _apply_rank1_updates(
     (dims layout). ONE implementation for both step bodies — the fuse
     gate, payload ordering, and fallback stay in lockstep by construction.
     """
-    fuse = pm and (
-        h_g.shape[0] * h_g.shape[1] * 4 <= _RANK1_FUSE_VMEM_BYTES
+    fuse = (
+        pm
+        and h_g.shape[0] * h_g.shape[1] * 4 <= _RANK1_FUSE_VMEM_BYTES
+        # scatter_add_rank1 accumulates runs in TABLE dtype; under bf16
+        # storage take the payload path instead, whose scatter pre-sums
+        # duplicates in fp32 (_dup_sum_f32) — round-once semantics.
+        and jnp.dtype(syn1_l.dtype).itemsize >= 4
     )
     if fuse:
         from glint_word2vec_tpu.ops.pallas_rows import scatter_add_rank1
@@ -311,6 +392,32 @@ class EmbeddingEngine:
         self.num_data = mesh.shape[DATA_AXIS]
         self.num_model = mesh.shape[MODEL_AXIS]
         self.layout = layout
+        # Fused Pallas pair-step megakernel (ISSUE 11, ops/pallas_sgns):
+        # rides the same pallas flag and replaces the composed pair-form
+        # step body wherever every table row is shard-local — the rows
+        # layout with an unsharded model axis (data parallelism is fine:
+        # coefficients/h are all_gathered exactly like the composed
+        # path). Model-sharded meshes keep the composed step (the fused
+        # forward would need a mid-kernel logit psum). Escape hatch:
+        # GLINT_W2V_PALLAS_FUSED=0 keeps the row kernels but not the
+        # fused step.
+        fused = (
+            self._pallas_mode != 0
+            and layout == "rows"
+            and self.num_model == 1
+            and os.environ.get("GLINT_W2V_PALLAS_FUSED", "1") == "1"
+        )
+        if fused and self.shared_negatives:
+            from glint_word2vec_tpu.ops.pallas_sgns import (
+                shared_pool_vmem_ok,
+            )
+
+            # The shared-pool forward pins the pool (storage + fp32) in
+            # VMEM; an oversized pool falls back to the composed step.
+            fused = shared_pool_vmem_ok(
+                self.shared_negatives, self.dim, self._dtype
+            )
+        self._pallas_fused = bool(fused)
         if layout == "rows":
             self.padded_vocab = pad_to_multiple(self.num_rows, self.num_model)
             self.rows_per_shard = self.padded_vocab // self.num_model
@@ -394,11 +501,101 @@ class EmbeddingEngine:
         Vs = self.rows_per_shard
         pm = self._pallas_mode
         n = self.num_negatives
+        if self._pallas_fused:
+            from glint_word2vec_tpu.ops import pallas_sgns
+        else:
+            pallas_sgns = None  # composed path never references it
         tspec = (
             P(MODEL_AXIS, None) if self.layout == "rows"
             else P(None, MODEL_AXIS)
         )
         rep = P()
+
+        def fused_pair_body(syn0_l, syn1_l, prob, alias, centers,
+                            contexts, mask, key, alpha):
+            # Fused Pallas pair step (ISSUE 11): every table row is
+            # shard-local (rows layout, num_model == 1), so the whole
+            # update runs as ops/pallas_sgns kernels — gathers, dot,
+            # sigmoid, and coefficient math in one VMEM-resident forward
+            # pass, then id-sorted run-summing scatters with fp32
+            # accumulation over the (fp32 or bf16) storage. Only the
+            # data axis remains: the exchange ships the SAME compact
+            # payload as the composed path (h, scalar coefficients,
+            # int32 ids — the gPlus/gMinus wire format) plus the (P, d)
+            # d_center rows the forward pass already materialized.
+            Bl = centers.shape[0]
+            drank = lax.axis_index(DATA_AXIS)
+            interp = pm == 2
+            a32 = alpha.astype(jnp.float32)
+            cen_g = lax.all_gather(centers, DATA_AXIS, tiled=True)
+            if self.shared_negatives:
+                # ONE pool per step, identical on every rank (shared
+                # key); the pool scoring and d_pool update run as dense
+                # level-3 BLAS blocks inside the forward kernel.
+                pool = sample_negatives(
+                    key, prob, alias, (self.shared_negatives,)
+                )
+                fw = pallas_sgns.pair_forward_shared(
+                    syn0_l, syn1_l, centers, contexts, mask, pool, a32,
+                    n, interpret=interp,
+                )
+                cpos_g = lax.all_gather(fw.c_pos, DATA_AXIS, tiled=True)
+                h_g = lax.all_gather(fw.h, DATA_AXIS, tiled=True)
+                dcen_g = lax.all_gather(fw.d_center, DATA_AXIS, tiled=True)
+                ctx_g = lax.all_gather(contexts, DATA_AXIS, tiled=True)
+                # Pool contributions sum across data ranks; after the
+                # psum the dense payload is identical everywhere.
+                dpool_g = lax.psum(fw.d_pool, DATA_AXIS)
+                P = cen_g.shape[0]
+                syn1_l = pallas_sgns.scatter_add_rank1_hbm(
+                    syn1_l, ctx_g, cpos_g, h_g,
+                    jnp.arange(P, dtype=jnp.int32), interpret=interp,
+                )
+                syn1_l = pallas_sgns.scatter_add_rows_f32(
+                    syn1_l, pool, dpool_g, interpret=interp
+                )
+            else:
+                # Per-pair negatives, keyed by GLOBAL pair row — the
+                # identical draw stream as the composed pair step.
+                rows_g = drank * Bl + jnp.arange(Bl, dtype=jnp.int32)
+                negs = sample_negatives_per_row(
+                    key, prob, alias, rows_g, (1, n)
+                )  # (Bl, 1, n)
+                nmask = sgns.negative_mask(
+                    negs, contexts[:, None], mask[:, None]
+                )
+                fw = pallas_sgns.pair_forward(
+                    syn0_l, syn1_l, centers, contexts, mask,
+                    negs[:, 0, :], nmask[:, 0, :], a32, interpret=interp,
+                )
+                cpos_g = lax.all_gather(fw.c_pos, DATA_AXIS, tiled=True)
+                cneg_g = lax.all_gather(fw.c_neg, DATA_AXIS, tiled=True)
+                h_g = lax.all_gather(fw.h, DATA_AXIS, tiled=True)
+                dcen_g = lax.all_gather(fw.d_center, DATA_AXIS, tiled=True)
+                ctx_g = lax.all_gather(contexts, DATA_AXIS, tiled=True)
+                negs_g = lax.all_gather(
+                    negs[:, 0, :], DATA_AXIS, tiled=True
+                )
+                P = cen_g.shape[0]
+                rows_p = jnp.arange(P, dtype=jnp.int32)
+                syn1_l = pallas_sgns.scatter_add_rank1_hbm(
+                    syn1_l,
+                    jnp.concatenate([ctx_g, negs_g.reshape(-1)]),
+                    jnp.concatenate([cpos_g, cneg_g.reshape(-1)]),
+                    h_g,
+                    jnp.concatenate([rows_p, jnp.repeat(rows_p, n)]),
+                    interpret=interp,
+                )
+            syn0_l = pallas_sgns.scatter_add_rows_f32(
+                syn0_l, cen_g, dcen_g, interpret=interp
+            )
+            # Same global masked-mean as the composed body: the kernel
+            # returns the SUM form directly.
+            denom = mask.sum()
+            loss = lax.psum(fw.loss_sum, DATA_AXIS) / jnp.maximum(
+                lax.psum(denom, DATA_AXIS), 1.0
+            )
+            return syn0_l, syn1_l, loss
 
         def step_body_rows(syn0_l, syn1_l, prob, alias, centers, cmask,
                            contexts, mask, key, alpha):
@@ -409,6 +606,15 @@ class EmbeddingEngine:
             # this is exactly the plain word vector).
             Bl, S = centers.shape
             C = contexts.shape[1]
+            if self._pallas_fused and S == 1 and C == 1:
+                # Dense pair form (the packed corpus scan / pair-step
+                # callers): the fused Pallas megakernel path. S/C are
+                # static python ints, so grid-shaped and subword-grouped
+                # traces keep the composed body below.
+                return fused_pair_body(
+                    syn0_l, syn1_l, prob, alias, centers[:, 0],
+                    contexts[:, 0], mask[:, 0], key, alpha,
+                )
             start = lax.axis_index(MODEL_AXIS) * Vs
             drank = lax.axis_index(DATA_AXIS)
 
@@ -619,10 +825,12 @@ class EmbeddingEngine:
             upd0_g = (dcen_g[:, None, :] * cmask_g[..., None]).reshape(
                 -1, dcen_g.shape[-1]
             )
-            # Every row is local: plain scatter-adds, no ownership masks.
-            syn0_l = syn0_l.at[ids0_g].add(upd0_g.astype(syn0_l.dtype))
+            # Every row is local: plain scatter-adds, no ownership masks
+            # (fp32 duplicate-row sums under bf16 storage, see
+            # _bf16_safe_scatter_add).
+            syn0_l = _bf16_safe_scatter_add(syn0_l, ids0_g, upd0_g)
             if upd1_g is not None:
-                syn1_l = syn1_l.at[ids1_g].add(upd1_g.astype(syn1_l.dtype))
+                syn1_l = _bf16_safe_scatter_add(syn1_l, ids1_g, upd1_g)
 
             denom = mask.sum()
             loss_sum = loss_local * jnp.maximum(denom, 1.0)
@@ -1383,6 +1591,26 @@ class EmbeddingEngine:
             )
         return self._compacted_offsets_host
 
+    def _scan_memo_key(self, kind: str, *shape_key):
+        """Memo key for :data:`_SCAN_MEMO`: the mesh geometry (device
+        ids + axis names) plus every engine attribute the scan
+        closures capture at trace time — two engines agreeing on this
+        key trace bitwise-identical programs (everything else is a
+        traced argument)."""
+        return (
+            kind,
+            tuple(d.id for d in self.mesh.devices.flat),
+            self.mesh.axis_names,
+            tuple(self.mesh.shape.items()),
+            self.layout,
+            str(self._dtype), str(self._compute_dtype),
+            self._pallas_mode, self._pallas_fused,
+            self.num_negatives, self.shared_negatives,
+            self.rows_per_shard, self.cols_per_shard,
+            self.padded_vocab, self.padded_dim,
+            *shape_key,
+        )
+
     def train_steps_corpus(
         self, start_position: int, batch_size: int, window: int,
         base_key, alphas, step0: int = 0
@@ -1404,9 +1632,11 @@ class EmbeddingEngine:
             )
         fn = self._corpus_scan_cache.get((B, W))
         if fn is None:
-            fn = self._corpus_scan_cache[(B, W)] = self._make_corpus_scan(
-                B, W
-            )
+            mk = self._scan_memo_key("grid", B, W)
+            fn = _SCAN_MEMO.get(mk)
+            if fn is None:
+                fn = _scan_memo_put(mk, self._make_corpus_scan(B, W))
+            self._corpus_scan_cache[(B, W)] = fn
         if getattr(self, "_corpus_compacted", None) is not None:
             ids, soffs = self._corpus_compacted
             n_valid = self._n_kept
@@ -1482,9 +1712,13 @@ class EmbeddingEngine:
         S, K = int(span), int(n_steps)
         fn = self._packed_scan_cache.get((P, W, B, S, K))
         if fn is None:
-            fn = self._packed_scan_cache[(P, W, B, S, K)] = (
-                self._make_packed_corpus_scan(P, W, B, S, K)
-            )
+            mk = self._scan_memo_key("packed", P, W, B, S, K)
+            fn = _SCAN_MEMO.get(mk)
+            if fn is None:
+                fn = _scan_memo_put(
+                    mk, self._make_packed_corpus_scan(P, W, B, S, K)
+                )
+            self._packed_scan_cache[(P, W, B, S, K)] = fn
         if getattr(self, "_corpus_compacted", None) is not None:
             ids, soffs = self._corpus_compacted
             n_valid = self._n_kept
@@ -2208,6 +2442,7 @@ class EmbeddingEngine:
                     tmp,
                     [fname for fname, _ in files] + ["engine.json"],
                     table_version,
+                    table_dtype=meta.get("dtype"),
                 ),
                 fsync=fsync,
             )
@@ -2248,6 +2483,7 @@ class EmbeddingEngine:
                     path,
                     [fname for fname, _ in files] + ["engine.json"],
                     table_version,
+                    table_dtype=meta.get("dtype"),
                 ),
                 fsync=fsync,
             )

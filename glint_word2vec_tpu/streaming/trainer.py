@@ -199,7 +199,7 @@ class StreamTrainer:
         ends or a stop bound trips."""
         import jax
 
-        from glint_word2vec_tpu.corpus.batching import context_width
+        from glint_word2vec_tpu.corpus.batching import packed_pair_batch
         from glint_word2vec_tpu.models.word2vec import (
             Word2VecModel,
             _ckpt_wait_timeout,
@@ -256,7 +256,9 @@ class StreamTrainer:
             if self.anneal_words else _NO_ANNEAL_WORDS
         )
         B, W, spc = p.batch_size, p.window, p.steps_per_call
-        pair_batch = B * context_width(W)
+        # ~B positions per packed step (grid-equivalent synchronous
+        # batch — see corpus/batching.packed_pair_batch).
+        pair_batch = packed_pair_batch(B, W, mesh.shape["data"])
         base_key = jax.random.PRNGKey(p.seed)
         keep = sv.keep_probabilities(p.subsample_ratio)
         rng = np.random.default_rng(p.seed)
@@ -500,6 +502,9 @@ class StreamTrainer:
         model.training_metrics = {
             **metrics.summary(),
             "pipeline": "stream",
+            # The stream drains through the packed pair scan, so it
+            # rides the fused Pallas megakernel whenever the engine does.
+            "pallas_fused": bool(getattr(engine, "_pallas_fused", False)),
             "rounds": self.rounds,
             "words_trained": self.words_trained,
             "vocab_size": sv.size,

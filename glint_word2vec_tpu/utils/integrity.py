@@ -46,11 +46,20 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
 
 
 def build_manifest(
-    dirpath: str, fnames: List[str], table_version: Optional[int] = None
+    dirpath: str, fnames: List[str], table_version: Optional[int] = None,
+    table_dtype: Optional[str] = None,
 ) -> dict:
     """Hash + size every named file in ``dirpath`` into a manifest dict.
     Runs on the checkpoint writer thread (async saves) — a streaming
-    read pass per file, cheap next to the durability fsyncs."""
+    read pass per file, cheap next to the durability fsyncs.
+
+    ``table_dtype`` records the engine's STORAGE dtype ("float32" |
+    "bfloat16") at snapshot time (ISSUE 11 bf16 tables): the .npy
+    payloads are always fp32 on disk (numpy has no bf16), so the
+    manifest is where a loader/auditor sees what precision the values
+    were rounded to before the upcast — engine.json's "dtype" is the
+    authoritative engine-rebuild field; this copy makes the integrity
+    artifact self-describing."""
     files: Dict[str, dict] = {}
     for fname in fnames:
         p = os.path.join(dirpath, fname)
@@ -61,6 +70,7 @@ def build_manifest(
     return {
         "version": 1,
         "table_version": table_version,
+        "table_dtype": table_dtype,
         "files": files,
     }
 

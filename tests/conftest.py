@@ -34,6 +34,15 @@ import jax
 # remote tunnel.
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: the jax persistent compilation cache is deliberately NOT
+# enabled here. It was tried as a tier-1 wall reclaim (fresh engines
+# can't share in-memory jit caches, so config-identical train scans
+# recompile once per test) and the CPU backend of this jax version
+# served cache-hit executables that broke checkpoint-resume BITWISE
+# parity and corrupted the heap at interpreter exit ("double free or
+# corruption"). Wall is reclaimed by session-scoped model fixtures and
+# slow-marking instead.
+
 import numpy as np
 import pytest
 

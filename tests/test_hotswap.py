@@ -18,6 +18,7 @@ import threading
 import urllib.error
 import urllib.request
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -350,3 +351,57 @@ def test_reload_rejects_geometry_mismatch(publish_dir, tmp_path):
     finally:
         server.stop()
         model.stop()
+
+
+def test_bf16_generation_round_trip(tmp_path):
+    """ISSUE 11 dtype round-trip: a bf16-STORAGE trainer publishes a
+    generation (fp32 .npy payloads, dtype recorded in engine.json AND
+    the integrity manifest); a bf16 serving engine hot-swaps it through
+    stage_tables/adopt_tables and the query path — fp32 norms, fp32
+    top-k scoring — returns ranks bitwise-stable against the
+    fp32-upcast oracle (numpy cosine over the upcast bf16 table)."""
+    Vv, d = 24, 16
+    words = [f"w{i}" for i in range(Vv)]
+    counts = np.arange(Vv, 0, -1, dtype=np.int64) * 5
+    rng = np.random.default_rng(0)
+    trainer = EmbeddingEngine(
+        make_mesh(1, 1), Vv, d, counts, num_negatives=2, seed=1,
+        dtype="bfloat16",
+    )
+    syn0 = rng.normal(0, 1.0, (Vv, d)).astype(np.float32)
+    trainer.set_tables(syn0, np.zeros_like(syn0))
+    pub = str(tmp_path / "pub")
+    SnapshotPublisher(
+        pub, trainer, Word2Vec(vector_size=d, dtype="bfloat16").params,
+    ).publish(_Vocab(words))
+    trainer.wait_pending_saves()
+    gen_matrix = os.path.join(pub, "gen-000001", "matrix")
+    # The integrity manifest records the storage dtype (the .npy
+    # payloads themselves are fp32 — numpy has no bf16).
+    manifest = json.load(open(os.path.join(gen_matrix, "manifest.json")))
+    assert manifest["table_dtype"] == "bfloat16"
+    meta = json.load(open(os.path.join(gen_matrix, "engine.json")))
+    assert meta["dtype"] == "bfloat16"
+    trainer.destroy()
+
+    server_eng = EmbeddingEngine(
+        make_mesh(1, 1), Vv, d, counts, num_negatives=2, seed=9,
+        dtype="bfloat16",
+    )
+    server_eng.adopt_tables(server_eng.stage_tables(gen_matrix))
+    assert server_eng.syn0.dtype == jnp.bfloat16
+    # Query path stays fp32: norms cache and top-k scores.
+    norms = server_eng.norms()
+    assert np.asarray(norms).dtype == np.float32
+    upcast = np.asarray(server_eng.syn0, np.float32)[:Vv]
+    safe = np.linalg.norm(upcast, axis=1)
+    for qi in (0, 3, 17):
+        q = upcast[qi] / np.linalg.norm(upcast[qi])
+        oracle = (upcast @ q) / safe
+        oracle_rank = np.argsort(-oracle)[:5]
+        sims, idx = server_eng.top_k_cosine(upcast[qi], 5)
+        np.testing.assert_array_equal(idx, oracle_rank)
+        np.testing.assert_allclose(
+            sims, oracle[oracle_rank], rtol=1e-6, atol=1e-7
+        )
+    server_eng.destroy()

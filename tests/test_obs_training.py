@@ -38,7 +38,10 @@ def test_instrumented_fit_event_log_and_chrome_trace(tiny_corpus, tmp_path):
     # engine-level events are all present.
     events = [json.loads(line) for line in open(log) if line.strip()]
     names = {e["name"] for e in events}
-    assert {"run_start", "run_end", "host_batch", "device_steps",
+    # The (dense-default) packed loop computes its LR schedule on
+    # device, so the grid loop's host_batch span is replaced by the
+    # deferred readback_harvest seam.
+    assert {"run_start", "run_end", "readback_harvest", "device_steps",
             "upload_corpus", "table_mutation"} <= names
     spans = [e for e in events if e["ph"] == "X"]
     assert spans and all(e["dur"] >= 0 for e in spans)
@@ -221,11 +224,23 @@ def test_canary_abort_on_device_corpus_path(tiny_corpus, monkeypatch):
     # losses from the scanned corpus dispatch must abort there too.
     from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
 
-    def nan_steps(self, start_position, batch_size, window, base_key,
-                  alphas, step0=0):
-        return np.full(len(alphas), np.nan, np.float32)
+    def nan_steps_packed(self, start_position, pair_batch, window,
+                         grid_batch, base_key, n_steps, step0=0,
+                         grid_step0=0, **kw):
+        # NaN losses + whole-corpus position advance: the (dense
+        # default) fit loop harvests one real step and the canary must
+        # trip on it.
+        K = int(n_steps)
+        return (
+            np.full(K, np.nan, np.float32),
+            np.full(K, int(pair_batch), np.int64),
+            np.full(K, 10**9, np.int64),
+            np.full(K, 0.025, np.float32),
+        )
 
-    monkeypatch.setattr(EmbeddingEngine, "train_steps_corpus", nan_steps)
+    monkeypatch.setattr(
+        EmbeddingEngine, "train_steps_corpus_packed", nan_steps_packed
+    )
     obs = ObsConfig(canary="abort", canary_check_every=1)
     w2v = Word2Vec(
         mesh=make_mesh(1, 2), obs=obs, vector_size=16, min_count=5,

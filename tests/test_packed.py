@@ -325,15 +325,37 @@ def test_set_batch_packing_validates():
 
     with pytest.raises(ValueError, match="batch_packing"):
         Word2VecParams(batch_packing="loose")
-    w = Word2Vec().set_batch_packing("dense")
-    assert w.params.batch_packing == "dense"
+    # Dense is the default (ISSUE 11); grid stays selectable.
+    assert Word2VecParams().batch_packing == "dense"
+    w = Word2Vec().set_batch_packing("grid")
+    assert w.params.batch_packing == "grid"
     # Round-trips through the persisted params metadata.
     p = Word2VecParams.from_json(w.params.to_json())
-    assert p.batch_packing == "dense"
-    # Old params.json without the field loads with the grid default.
+    assert p.batch_packing == "grid"
+    # Old params.json without the field loads with the (dense) default.
     blob = json.loads(w.params.to_json())
     del blob["batch_packing"]
-    assert Word2VecParams.from_json(json.dumps(blob)).batch_packing == "grid"
+    assert (
+        Word2VecParams.from_json(json.dumps(blob)).batch_packing == "dense"
+    )
+
+
+def test_packed_pair_batch_sizing():
+    # The dense default's pair batch covers ~batch_size center positions
+    # in EXPECTATION (E[pairs/position] = (W-1)^2/W), so a packed step
+    # trains the same effective synchronous batch as a grid step — the
+    # update-dynamics contract of the default flip (sizing at the grid's
+    # full lane count trained a ~2.3x larger synchronous batch, which
+    # destabilized hot rows on small vocabularies). Floors: the lane
+    # count (pack_window_pairs forward progress) and the data-axis
+    # multiple.
+    from glint_word2vec_tpu.corpus.batching import packed_pair_batch
+
+    assert packed_pair_batch(256, 5) == 820  # ceil(256 * (4^2/5))
+    assert packed_pair_batch(256, 5) < 256 * context_width(5)  # << B*C
+    assert packed_pair_batch(256, 5, 8) % 8 == 0
+    assert packed_pair_batch(1, 5) >= context_width(5)
+    assert packed_pair_batch(1, 2) >= context_width(2)
 
 
 @pytest.mark.parametrize("subsample_ratio", [0.0, 0.01])
@@ -353,6 +375,15 @@ def test_packed_fit_words_done_matches_grid(subsample_ratio):
     )
     assert m_dense.training_metrics["batch_packing"] == "dense"
     assert m_dense.training_metrics["packed_mask_density"] >= 0.9
+    # Position-matched pair batches (packed_pair_batch) keep the dense
+    # fit at ~the grid fit's step cadence — the same effective
+    # synchronous batch per step (the old B*C sizing ran ~0.35x the
+    # steps, i.e. a ~2.3x larger synchronous batch, which destabilized
+    # hot rows on small vocabularies).
+    assert (
+        m_dense.training_metrics["steps"]
+        >= 0.6 * m_grid.training_metrics["steps"]
+    ), (m_dense.training_metrics["steps"], m_grid.training_metrics["steps"])
     # The packed model still learns a queryable table.
     assert len(m_dense.find_synonyms("quick", 3)) == 3
 
@@ -409,7 +440,7 @@ def test_packed_fit_boundary_checkpoint_resume(tmp_path):
 def test_mid_epoch_state_refuses_cross_mode_resume(tmp_path, monkeypatch):
     # A mid-epoch packed state resumed in grid mode would silently drop
     # the consumed-position counter and re-train the epoch's consumed
-    # prefix; the loop must refuse instead. Epoch-BOUNDARY packed states
+    # prefix; the loop must refuse instead. Epoch-BOUNDARY states
     # (position 0) stay resumable from either mode.
     ck = str(tmp_path / "ck")
     os.makedirs(ck, exist_ok=True)
@@ -418,13 +449,17 @@ def test_mid_epoch_state_refuses_cross_mode_resume(tmp_path, monkeypatch):
     monkeypatch.delenv("GLINT_PACKED_STOP_AFTER_GROUPS")
     assert json.load(open(os.path.join(ck, "train_state.json")))["position"] > 0
     with pytest.raises(ValueError, match="batch_packing"):
-        _w2v().fit(CORPUS, checkpoint_dir=ck)
+        _w2v(batch_packing="grid").fit(CORPUS, checkpoint_dir=ck)
+    # The (dense) default resumes its own mid-epoch state fine.
+    _w2v().fit(CORPUS, checkpoint_dir=ck)
     ck2 = str(tmp_path / "ck2")
     os.makedirs(ck2, exist_ok=True)
     _w2v(num_iterations=2, batch_packing="dense").fit(
         CORPUS, checkpoint_dir=ck2, stop_after_epochs=1
     )
-    m = _w2v(num_iterations=2).fit(CORPUS, checkpoint_dir=ck2)
+    m = _w2v(num_iterations=2, batch_packing="grid").fit(
+        CORPUS, checkpoint_dir=ck2
+    )
     assert m.training_metrics["pipeline"] == "device_corpus"
 
 
