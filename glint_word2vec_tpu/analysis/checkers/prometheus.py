@@ -58,6 +58,11 @@ PRODUCERS: Dict[str, Tuple[str, ...]] = {
         "glint_word2vec_tpu/obs/heartbeat.py",
         "glint_word2vec_tpu/utils/metrics.py",
     ),
+    "fleet_to_prometheus": (
+        "glint_word2vec_tpu/fleet.py",
+        "glint_word2vec_tpu/obs/aggregate.py",
+        "glint_word2vec_tpu/utils/metrics.py",
+    ),
 }
 
 _NAME_RE = re.compile(r"^[a-z_:][a-z0-9_:]*$")

@@ -128,6 +128,32 @@ SYNC_SEAMS: Dict[str, str] = {
     "glint_word2vec_tpu/models/word2vec.py::"
     "LocalWord2VecModel.find_synonyms_vector":
         "local numpy model: every value is already host",
+    # ANN index lifecycle seams (ISSUE 12): builds and incremental
+    # re-bucketing run OFF the request path by contract (boot, the
+    # hot-swap staging thread, or a streaming promotion burst) — the
+    # assignment readbacks and host member packing are the design.
+    "glint_word2vec_tpu/ops/ann.py::build":
+        "index build seam: k-means assignment readbacks + host member "
+        "packing, off the request path (boot / hot-swap staging)",
+    "glint_word2vec_tpu/ops/ann.py::add_rows":
+        "incremental re-bucket seam: score readback for only the "
+        "touched rows (streaming promotions), off the request path",
+    "glint_word2vec_tpu/ops/ann.py::_pack_members":
+        "host member packing invoked only from the build seam: every "
+        "value is a host numpy scalar by then",
+    "glint_word2vec_tpu/ops/ann.py::_drop_row":
+        "host member-layout bookkeeping: slot ids are host numpy ints",
+    "glint_word2vec_tpu/ops/ann.py::remove_rows":
+        "host member-layout bookkeeping: freed row ids arrive as host "
+        "ints from the engine",
+    "glint_word2vec_tpu/parallel/engine.py::"
+    "EmbeddingEngine.ann_top_k_batch":
+        "serving query op: returns host (vals, ids) by contract, the "
+        "approximate twin of top_k_cosine_batch",
+    "glint_word2vec_tpu/parallel/engine.py::"
+    "EmbeddingEngine.ann_recall_at_k":
+        "recall-gate seam: compares exact vs approximate host id sets "
+        "at build/refresh time, off the request path",
 }
 
 #: Expression roots that are host values by construction — calling

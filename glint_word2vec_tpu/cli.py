@@ -231,6 +231,51 @@ def _add_fit_stream(sub):
                           "device_steps, publish, table mutations)")
 
 
+def _add_ann_flags(p):
+    ann = p.add_argument_group(
+        "approximate serving (ANN index)",
+        "two-stage device top-k: k-means centroids trained on-device "
+        "from the live table, coarse scores pick nprobe clusters, "
+        "exact rerank inside them — O(sqrt(V)*d)-ish per query with a "
+        "measured recall@10 gate against the exact path (per-request "
+        '{"exact": true} always escapes to the exact masked GEMM)',
+    )
+    ann.add_argument("--ann", action="store_true",
+                     help="enable the approximate /synonyms path "
+                          "(built + recall-gated before the port "
+                          "binds; refreshed on every hot-swap)")
+    ann.add_argument("--ann-clusters", type=int, default=-1,
+                     help="coarse cluster count (-1 auto: "
+                          "next_pow2(sqrt(rows)))")
+    ann.add_argument("--ann-nprobe", type=int, default=8,
+                     help="clusters probed per query (default 8)")
+    ann.add_argument("--ann-iters", type=int, default=6,
+                     help="k-means sweeps per index build (default 6)")
+    ann.add_argument("--ann-sample", type=int, default=65536,
+                     help="rows sampled for centroid training "
+                          "(default 65536; the full table is always "
+                          "assigned)")
+    ann.add_argument("--ann-recall-gate", type=float, default=0.95,
+                     help="minimum measured recall@10 vs the exact "
+                          "path; below it the exact path keeps "
+                          "serving (default 0.95)")
+    ann.add_argument("--ann-recall-sample", type=int, default=64,
+                     help="query rows sampled per recall measurement "
+                          "(default 64)")
+
+
+def _ann_kwargs(args) -> dict:
+    return dict(
+        ann=args.ann,
+        ann_clusters=args.ann_clusters,
+        ann_nprobe=args.ann_nprobe,
+        ann_iters=args.ann_iters,
+        ann_sample=args.ann_sample,
+        ann_recall_gate=args.ann_recall_gate,
+        ann_recall_sample=args.ann_recall_sample,
+    )
+
+
 def _add_query(sub):
     p = sub.add_parser("synonyms", help="nearest neighbors of a word")
     p.add_argument("--model", required=True)
@@ -281,6 +326,12 @@ def _add_query(sub):
     p.add_argument("--cache-size", type=int, default=65536,
                    help="synonym result-cache entries (0 disables); "
                         "invalidated wholesale on any table mutation")
+    p.add_argument("--port-file", default=None, metavar="FILE",
+                   help="write the bound {host, port} JSON here once "
+                        "the server is warmed and listening (the "
+                        "fleet launcher's readiness barrier for "
+                        "--port 0)")
+    _add_ann_flags(p)
     over = p.add_argument_group(
         "overload protection",
         "bounded admission + per-request deadlines + degraded "
@@ -303,6 +354,40 @@ def _add_query(sub):
                            "(serve cache hits, shed misses with 429) "
                            "until the lock frees (0 disables; "
                            "default 5)")
+
+    p = sub.add_parser(
+        "serve-fleet",
+        help="launch N serving replicas following one model (or one "
+             "publish dir) behind a front load balancer: round-robin "
+             "spread, overload-aware retry on the replicas' 429/503 "
+             "backpressure, one merged fleet /metrics exposition",
+    )
+    p.add_argument("--model", default=None,
+                   help="saved model directory every replica loads")
+    p.add_argument("--watch-checkpoint", default=None, metavar="DIR",
+                   help="publish dir every replica follows (each "
+                        "committed generation hot-swaps the WHOLE "
+                        "fleet, index refresh included)")
+    p.add_argument("--watch-poll", type=float, default=1.0)
+    p.add_argument("--replicas", type=int, default=2,
+                   help="serving process count (default 2)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="balancer bind address")
+    p.add_argument("--port", type=int, default=8800,
+                   help="balancer port (0 = ephemeral; replicas "
+                        "always bind ephemeral ports)")
+    p.add_argument("--port-file", default=None, metavar="FILE",
+                   help="write the balancer's bound {host, port} here "
+                        "once the fleet is up")
+    p.add_argument("--replica-log-dir", default=None, metavar="DIR",
+                   help="capture one replica-N.log per process "
+                        "(default: replicas inherit stderr)")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--cache-size", type=int, default=65536)
+    p.add_argument("--max-inflight", type=int, default=256)
+    p.add_argument("--request-deadline", type=float, default=30.0)
+    p.add_argument("--degraded-after", type=float, default=5.0)
+    _add_ann_flags(p)
 
     p = sub.add_parser(
         "supervise",
@@ -622,11 +707,54 @@ def _run_fit_stream(args) -> int:
     return 0
 
 
+def _run_serve_fleet(args) -> int:
+    from glint_word2vec_tpu.fleet import serve_fleet
+
+    if args.model is None and args.watch_checkpoint is None:
+        print(
+            "error: serve-fleet needs --model or --watch-checkpoint",
+            file=sys.stderr,
+        )
+        return 1
+    flags = [
+        "--max-batch", str(args.max_batch),
+        "--cache-size", str(args.cache_size),
+        "--max-inflight", str(args.max_inflight),
+        "--request-deadline", str(args.request_deadline),
+        "--degraded-after", str(args.degraded_after),
+        "--watch-poll", str(args.watch_poll),
+    ]
+    if args.ann:
+        flags += [
+            "--ann",
+            "--ann-clusters", str(args.ann_clusters),
+            "--ann-nprobe", str(args.ann_nprobe),
+            "--ann-iters", str(args.ann_iters),
+            "--ann-sample", str(args.ann_sample),
+            "--ann-recall-gate", str(args.ann_recall_gate),
+            "--ann-recall-sample", str(args.ann_recall_sample),
+        ]
+    return serve_fleet(
+        args.model,
+        replicas=args.replicas,
+        host=args.host,
+        port=args.port,
+        watch_dir=args.watch_checkpoint,
+        replica_flags=flags,
+        log_dir=args.replica_log_dir,
+        port_file=args.port_file,
+    )
+
+
 def _run(args) -> int:
     if args.cmd == "supervise":
         # Before force_platform/jax: the supervisor process never
         # touches a device.
         return _run_supervise(args)
+    if args.cmd == "serve-fleet":
+        # Likewise device-free: the balancer proxies; only the replica
+        # SUBPROCESSES load tables.
+        return _run_serve_fleet(args)
 
     from glint_word2vec_tpu.utils.platform import force_platform
 
@@ -725,6 +853,8 @@ def _run(args) -> int:
             degraded_after=args.degraded_after,
             watch_dir=args.watch_checkpoint,
             watch_poll=args.watch_poll,
+            port_file=args.port_file,
+            **_ann_kwargs(args),
         )
         return 0
 

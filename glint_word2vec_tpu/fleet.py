@@ -1,0 +1,704 @@
+"""Horizontal serving: N replica processes behind one load balancer.
+
+One serving process tops out on one device and one GIL; production
+traffic needs N of them. This module adds the front half of ISSUE 12's
+scale-out story:
+
+* :class:`LoadBalancer` — a stdlib HTTP proxy that spreads requests
+  round-robin over a replica fleet, using the replicas' OWN overload
+  signals (PR 7's bounded-admission 429 and degraded-mode 429/503) as
+  honest backpressure: a shed replica is skipped for the next one, and
+  only when EVERY replica sheds does the client see the 429 (with its
+  ``Retry-After``) — the balancer never invents capacity, it only finds
+  it. Per-replica connections are kept alive per handler thread, so the
+  proxy adds one local hop, not a reconnect.
+
+* Fleet observability — ``GET /metrics`` scrapes every replica's JSON
+  snapshot and folds them through PR 8's
+  :func:`~glint_word2vec_tpu.obs.aggregate.merge_serving_snapshots`
+  into ONE ServingMetrics-shaped document (rendered by the same
+  ``serving_to_prometheus``, index family included), alongside
+  per-replica blocks and the balancer's own counters
+  (``fleet_to_prometheus``).
+
+* :func:`serve_fleet` — the launcher: N ``cli serve`` subprocesses on
+  ephemeral ports following one model dir (or one publish dir, so a
+  streaming trainer hot-swaps the WHOLE fleet), readiness via each
+  replica's ``--port-file`` (written only after warmup, so the
+  balancer never routes to a cold replica), then the balancer in the
+  launcher process. ``POST /shutdown`` on the balancer fans out to
+  every replica and stops the fleet — the one-switch teardown CI uses.
+
+Replicas are plain ``serve`` processes: nothing here is in their code
+path, so a balancer crash leaves N independently addressable servers.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+
+def _read_request(sock, buf: bytearray):
+    """Read one HTTP/1.1 request off a keep-alive socket: returns
+    (method, path, lowercase-header dict, body) or None on a clean
+    close between requests. Raises on transport errors or malformed
+    framing. Content-Length framing only — the serving stack (and
+    every client of it) never chunks."""
+    while True:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end >= 0:
+            break
+        chunk = sock.recv(65536)
+        if not chunk:
+            if buf:
+                raise ConnectionError("client closed mid-request")
+            return None
+        buf += chunk
+    head = bytes(buf[:head_end]).decode("latin-1")
+    lines = head.split("\r\n")
+    parts = lines[0].split(None, 2)
+    if len(parts) < 3:
+        raise ValueError(f"malformed request line: {lines[0]!r}")
+    method, path = parts[0], parts[1]
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    clen = int(headers.get("content-length", 0))
+    body_end = head_end + 4 + clen
+    while len(buf) < body_end:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("client closed mid-body")
+        buf += chunk
+    body = bytes(buf[head_end + 4 : body_end])
+    del buf[:body_end]
+    return method, path, headers, body
+
+#: Statuses that mean "this replica cannot take the request right now,
+#: another one might": bounded admission / degraded mode (429), plus
+#: 503 for a replica mid-restart behind a stale port. 404/400/504 are
+#: NOT retried — they are answers about the request, not the replica.
+_SHED_STATUSES = frozenset((429, 503))
+
+
+class _ReplicaConn:
+    """One persistent keep-alive socket to a replica with a minimal
+    HTTP/1.1 reader — the balancer's per-request cost IS the fleet's
+    overhead floor, so the proxy hop skips ``http.client`` entirely.
+    Owned by exactly one handler thread (per-thread pools), so no
+    locking. The replica always answers Content-Length-framed JSON
+    (serving.py's ``_send``)."""
+
+    __slots__ = ("host", "port", "timeout", "_sock", "_buf", "_prefix")
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock = None
+        self._buf = bytearray()
+        self._prefix = (
+            f"Host: {host}:{port}\r\n"
+            "Content-Type: application/json\r\n"
+            "Content-Length: "
+        )
+
+    def _connect(self):
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        # NODELAY: requests/responses are small multi-segment writes;
+        # Nagle + delayed ACK turns each proxied call into a ~40ms
+        # stall otherwise (the PR 2 serving-side fix, outbound twin).
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._buf.clear()
+        return s
+
+    def roundtrip(self, method: str, path: str, body: bytes):
+        """One request/response exchange; returns (status, body,
+        header-dict with lowercase keys). Raises on any transport
+        error (caller drops the connection and tries the next
+        replica)."""
+        sock = self._sock or self._connect()
+        req = (
+            f"{method} {path} HTTP/1.1\r\n{self._prefix}"
+            f"{len(body)}\r\n\r\n"
+        ).encode("latin-1") + body
+        try:
+            sock.sendall(req)
+        except OSError:
+            # The replica closed our idle keep-alive socket (timeout,
+            # restart): one fresh-connection retry is safe — nothing
+            # of this request reached a handler.
+            sock = self._connect()
+            sock.sendall(req)
+        buf = self._buf
+        while True:
+            head_end = buf.find(b"\r\n\r\n")
+            if head_end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("replica closed mid-response")
+            buf += chunk
+        head = bytes(buf[:head_end]).decode("latin-1")
+        lines = head.split("\r\n")
+        status = int(lines[0].split(None, 2)[1])
+        headers = {}
+        clen = 0
+        for line in lines[1:]:
+            k, _, v = line.partition(":")
+            k = k.strip().lower()
+            v = v.strip()
+            headers[k] = v
+            if k == "content-length":
+                clen = int(v)
+        body_end = head_end + 4 + clen
+        while len(buf) < body_end:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("replica closed mid-body")
+            buf += chunk
+        rbody = bytes(buf[head_end + 4 : body_end])
+        del buf[:body_end]
+        return status, rbody, headers
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+class LoadBalancer:
+    """Round-robin HTTP proxy over serving replicas with
+    overload-aware retry and a merged fleet exposition.
+
+    Routes:
+      GET  /healthz   fleet health: replicas up/total (200 while >= 1 up)
+      GET  /metrics   merged fleet snapshot (JSON; ?format=prometheus
+                      renders the merged serving exposition + the
+                      glint_fleet_* balancer family)
+      POST /shutdown  fan-out shutdown to every replica, then stop
+      anything else   proxied to a replica (round robin; sheds retried
+                      on the next replica, exhaustion relays the shed)
+    """
+
+    def __init__(self, replica_urls: List[str], host: str = "127.0.0.1",
+                 port: int = 0, *, scrape_timeout: float = 2.0,
+                 proxy_timeout: float = 60.0):
+        self.replicas = [self._parse(u) for u in replica_urls]
+        if not self.replicas:
+            raise ValueError("at least one replica url required")
+        self.scrape_timeout = float(scrape_timeout)
+        self.proxy_timeout = float(proxy_timeout)
+        self._mu = threading.Lock()
+        self._rr = 0
+        self._proxied = [0] * len(self.replicas)
+        self._errors = [0] * len(self.replicas)
+        self._shed_retries = 0
+        self._exhausted = 0
+        self._local = threading.local()
+        # Data plane: a thread-per-connection raw-socket loop with a
+        # minimal HTTP/1.1 parser instead of ThreadingHTTPServer. The
+        # balancer's per-request GIL time is the FLEET's throughput
+        # ceiling — BaseHTTPRequestHandler's readline/email parsing and
+        # per-response date formatting alone cost more than a whole
+        # warmed ANN dispatch, and at N replicas the proxy must stay
+        # the cheapest stage in the chain.
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prev_switch: Optional[float] = None
+
+    # -- data plane ----------------------------------------------------
+
+    _STATUS_LINE = {
+        code: f"HTTP/1.1 {code} {reason}\r\n".encode("latin-1")
+        for code, reason in (
+            (200, "OK"), (400, "Bad Request"), (404, "Not Found"),
+            (429, "Too Many Requests"), (500, "Internal Server Error"),
+            (503, "Service Unavailable"), (504, "Gateway Timeout"),
+        )
+    }
+
+    def _respond(self, sock, code: int, body: bytes, ctype: str,
+                 retry_after: Optional[str] = None) -> None:
+        head = self._STATUS_LINE.get(
+            code, f"HTTP/1.1 {code} X\r\n".encode("latin-1")
+        )
+        extra = (
+            f"Retry-After: {retry_after}\r\n" if retry_after else ""
+        )
+        sock.sendall(
+            head
+            + (
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n{extra}\r\n"
+            ).encode("latin-1")
+            + body
+        )
+
+    def _respond_json(self, sock, code: int, obj,
+                      retry_after: Optional[str] = None) -> None:
+        self._respond(
+            sock, code, json.dumps(obj).encode(), "application/json",
+            retry_after,
+        )
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="glint-fleet-conn",
+            ).start()
+
+    def _serve_conn(self, sock) -> None:
+        """One client connection: parse requests with the minimal
+        framed reader, route control paths locally, proxy the rest.
+        Keep-alive by default (HTTP/1.1); 'Connection: close' honored."""
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        buf = bytearray()
+        try:
+            while not self._stop.is_set():
+                req = _read_request(sock, buf)
+                if req is None:
+                    return  # client closed between requests
+                method, path, headers, body = req
+                self._route(sock, method, path, headers, body)
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (OSError, ValueError, ConnectionError):
+            pass  # torn client connection / malformed request
+        finally:
+            sock.close()
+            pool = getattr(self._local, "conns", None)
+            if pool:
+                for c in pool.values():
+                    c.close()
+                pool.clear()
+
+    def _route(self, sock, method: str, path: str, headers: dict,
+               body: bytes) -> None:
+        url = urlparse(path)
+        if method == "GET" and url.path == "/healthz":
+            up, total, states = self.health()
+            return self._respond_json(sock, 200 if up else 503, {
+                "status": "ok" if up == total else (
+                    "degraded" if up else "down"
+                ),
+                "replicas": total,
+                "replicas_up": up,
+                "replica_states": states,
+            })
+        if method == "GET" and url.path == "/metrics":
+            doc = self.metrics_doc()
+            fmt = parse_qs(url.query).get("format", ["json"])[0]
+            if fmt == "prometheus":
+                from glint_word2vec_tpu.obs.prometheus import (
+                    fleet_to_prometheus,
+                    serving_to_prometheus,
+                )
+
+                text = fleet_to_prometheus(doc)
+                if doc.get("fleet"):
+                    text += serving_to_prometheus(doc["fleet"])
+                return self._respond(
+                    sock, 200, text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            return self._respond_json(sock, 200, doc)
+        if method == "POST" and url.path == "/shutdown":
+            results = self.shutdown_fleet()
+            self._respond_json(sock, 200, {
+                "status": "shutting down fleet",
+                "replicas": results,
+            })
+            threading.Thread(target=self.stop, daemon=True).start()
+            return
+        status, rbody, rheaders = self.forward(method, path, body)
+        self._respond(
+            sock, status, rbody,
+            rheaders.get("content-type") or "application/json",
+            rheaders.get("retry-after"),
+        )
+
+    @staticmethod
+    def _parse(url: str):
+        u = urlparse(url if "//" in url else f"http://{url}")
+        return (u.hostname, int(u.port))
+
+    # -- request forwarding --------------------------------------------
+
+    def _conn(self, i: int) -> "_ReplicaConn":
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        c = pool.get(i)
+        if c is None:
+            host, port = self.replicas[i]
+            c = pool[i] = _ReplicaConn(host, port, self.proxy_timeout)
+        return c
+
+    def _drop_conn(self, i: int) -> None:
+        pool = getattr(self._local, "conns", None)
+        if pool and i in pool:
+            try:
+                pool.pop(i).close()
+            except Exception:
+                pass
+
+    def _next_start(self) -> int:
+        with self._mu:
+            self._rr += 1
+            return self._rr
+
+    def forward(self, method: str, path: str, body: bytes):
+        """Send one request to the fleet: round-robin start, advance on
+        connection failure or a shed status (429/503), at most one
+        attempt per replica. Returns (status, body, headers). When
+        every replica sheds, the LAST shed response is relayed — its
+        Retry-After included — so the client sees the fleet's own
+        backpressure, not an invented error.
+
+        The hop rides one persistent raw keep-alive socket per
+        (handler thread, replica) with a minimal response reader: at
+        fleet throughput the balancer's per-request CPU is the fleet's
+        overhead floor, so the hot path avoids the ``http.client``
+        object machinery entirely."""
+        n = len(self.replicas)
+        start = self._next_start()
+        last_shed = None
+        attempted = 0
+        for j in range(n):
+            i = (start + j) % n
+            try:
+                status, rbody, rheaders = self._conn(i).roundtrip(
+                    method, path, body
+                )
+            except Exception:
+                self._drop_conn(i)
+                with self._mu:
+                    self._errors[i] += 1
+                attempted += 1
+                continue
+            attempted += 1
+            if status in _SHED_STATUSES:
+                last_shed = (status, rbody, rheaders)
+                with self._mu:
+                    self._shed_retries += 1
+                continue
+            with self._mu:
+                self._proxied[i] += 1
+            return status, rbody, rheaders
+        with self._mu:
+            self._exhausted += 1
+        if last_shed is not None:
+            return last_shed
+        return (
+            503,
+            json.dumps({
+                "error": f"no replica reachable ({attempted} tried)"
+            }).encode(),
+            {"Content-Type": "application/json", "Retry-After": "1"},
+        )
+
+    # -- fleet views ---------------------------------------------------
+
+    def _get_json(self, i: int, path: str):
+        host, port = self.replicas[i]
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.scrape_timeout
+        )
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read().decode())
+        finally:
+            conn.close()
+
+    def health(self):
+        """(up, total, per-replica state) from each replica's
+        /healthz; a dead replica reports "unreachable"."""
+        states = []
+        up = 0
+        for i in range(len(self.replicas)):
+            try:
+                status, h = self._get_json(i, "/healthz")
+                state = h.get("status", f"http {status}")
+                if status == 200:
+                    up += 1
+            except Exception:
+                state = "unreachable"
+            states.append({
+                "url": self.replica_url(i), "state": state,
+            })
+        return up, len(self.replicas), states
+
+    def replica_url(self, i: int) -> str:
+        host, port = self.replicas[i]
+        return f"http://{host}:{port}"
+
+    def balancer_stats(self) -> dict:
+        with self._mu:
+            return {
+                "shed_retries_total": self._shed_retries,
+                "exhausted_total": self._exhausted,
+                "proxied_total": int(sum(self._proxied)),
+                "proxy_errors_total": int(sum(self._errors)),
+            }
+
+    def metrics_doc(self) -> dict:
+        """The merged fleet document: per-replica snapshots (scraped
+        now, failures reported not fatal), the PR 8 exact merge as
+        ``fleet``, and the balancer's own counters."""
+        from glint_word2vec_tpu.obs.aggregate import (
+            merge_serving_snapshots,
+        )
+
+        replicas = []
+        snaps = []
+        with self._mu:
+            proxied = list(self._proxied)
+            errors = list(self._errors)
+        for i in range(len(self.replicas)):
+            entry: Dict[str, object] = {
+                "url": self.replica_url(i),
+                "proxied_total": proxied[i],
+                "proxy_errors_total": errors[i],
+            }
+            try:
+                _, snap = self._get_json(i, "/metrics")
+                entry["up"] = True
+                entry["snapshot"] = snap
+                snaps.append(snap)
+            except Exception as e:
+                entry["up"] = False
+                entry["scrape_error"] = str(e)
+            replicas.append(entry)
+        return {
+            "replicas": replicas,
+            "fleet": merge_serving_snapshots(snaps),
+            "balancer": self.balancer_stats(),
+        }
+
+    def shutdown_fleet(self) -> List[dict]:
+        """POST /shutdown to every replica (best effort)."""
+        results = []
+        for i in range(len(self.replicas)):
+            host, port = self.replicas[i]
+            try:
+                conn = http.client.HTTPConnection(
+                    host, port, timeout=self.scrape_timeout
+                )
+                try:
+                    conn.request(
+                        "POST", "/shutdown", body=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    results.append({
+                        "url": self.replica_url(i),
+                        "status": resp.status,
+                    })
+                finally:
+                    conn.close()
+            except Exception as e:
+                results.append({
+                    "url": self.replica_url(i), "error": str(e),
+                })
+        return results
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _tighten_gil_switch(self) -> None:
+        # One handler thread per client connection, each a chain of
+        # short GIL-holding sections (parse, forward, relay): at the
+        # default 5ms switch interval the convoy adds whole scheduling
+        # quanta per proxied call (the same effect serving.py tightens
+        # for). Restored by stop().
+        if self._prev_switch is None:
+            self._prev_switch = sys.getswitchinterval()
+            sys.setswitchinterval(0.001)
+
+    def serve_forever(self) -> None:
+        logger.info(
+            "fleet balancer on %s:%d over %d replica(s)",
+            self.host, self.port, len(self.replicas),
+        )
+        self._tighten_gil_switch()
+        self._accept_loop()
+
+    def start_background(self) -> None:
+        self._tighten_gil_switch()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="glint-fleet-lb",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Waking a thread blocked in accept() needs more than close():
+        # on Linux, closing the fd from another thread leaves the
+        # accept blocked forever. shutdown() wakes it with EINVAL; the
+        # best-effort self-connect covers platforms where it doesn't.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            socket.create_connection(
+                (self.host, self.port), timeout=1
+            ).close()
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:  # pragma: no cover - already closed
+            pass
+        if self._prev_switch is not None:
+            sys.setswitchinterval(self._prev_switch)
+            self._prev_switch = None
+
+
+# ----------------------------------------------------------------------
+# Launcher
+# ----------------------------------------------------------------------
+
+
+def _replica_argv(i: int, port_file: str, model_dir: Optional[str],
+                  watch_dir: Optional[str], replica_flags: List[str]):
+    argv = [
+        sys.executable, "-m", "glint_word2vec_tpu.cli", "serve",
+        "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
+    ]
+    if model_dir:
+        argv += ["--model", model_dir]
+    if watch_dir:
+        argv += ["--watch-checkpoint", watch_dir]
+    return argv + list(replica_flags)
+
+
+def serve_fleet(
+    model_dir: Optional[str],
+    *,
+    replicas: int = 2,
+    host: str = "127.0.0.1",
+    port: int = 8800,
+    watch_dir: Optional[str] = None,
+    replica_flags: Optional[List[str]] = None,
+    log_dir: Optional[str] = None,
+    ready_timeout: float = 900.0,
+    port_file: Optional[str] = None,
+) -> int:
+    """Launch ``replicas`` serving processes following one model (or
+    one publish dir) and front them with a :class:`LoadBalancer` in
+    this process until killed.
+
+    Each replica binds an ephemeral port and signals readiness through
+    its ``--port-file`` — written only after the full serving warmup
+    (and ANN build + recall gate, when enabled), so the balancer's
+    first request never lands on a cold replica. ``replica_flags``
+    pass through to every ``cli serve`` invocation verbatim (ann
+    flags, cache size, overload bounds...). ``log_dir`` captures one
+    ``replica-N.log`` per process; default inherits stderr.
+
+    Returns the exit code (0 on clean shutdown). A dead replica is NOT
+    relaunched here — run replicas under ``cli supervise`` for that;
+    the balancer keeps serving from the survivors either way.
+    """
+    import tempfile
+
+    replicas = max(1, int(replicas))
+    procs: List[subprocess.Popen] = []
+    logs = []
+    with tempfile.TemporaryDirectory(prefix="glint_fleet_") as tmp:
+        port_files = [
+            os.path.join(tmp, f"replica-{i}.port") for i in range(replicas)
+        ]
+        try:
+            for i in range(replicas):
+                stderr = None
+                if log_dir:
+                    os.makedirs(log_dir, exist_ok=True)
+                    # graftlint: ignore[atomic-persist] append-mode process log, not an artifact
+                    f = open(
+                        os.path.join(log_dir, f"replica-{i}.log"), "ab"
+                    )
+                    logs.append(f)
+                    stderr = f
+                procs.append(subprocess.Popen(
+                    _replica_argv(
+                        i, port_files[i], model_dir, watch_dir,
+                        replica_flags or [],
+                    ),
+                    stdout=stderr, stderr=stderr,
+                ))
+            urls = []
+            deadline = time.time() + ready_timeout
+            for i, pf in enumerate(port_files):
+                while not os.path.exists(pf):
+                    if procs[i].poll() is not None:
+                        raise RuntimeError(
+                            f"replica {i} exited rc={procs[i].returncode} "
+                            "before binding its port"
+                        )
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"replica {i} not ready in {ready_timeout}s"
+                        )
+                    time.sleep(0.1)
+                with open(pf) as f:
+                    info = json.load(f)
+                urls.append(f"http://{info['host']}:{info['port']}")
+            lb = LoadBalancer(urls, host=host, port=port)
+            if port_file:
+                from glint_word2vec_tpu.utils import atomic_write_json
+
+                atomic_write_json(
+                    port_file, {"host": lb.host, "port": lb.port}
+                )
+            logger.info(
+                "fleet up: %d replicas (%s) behind %s:%d",
+                replicas, ", ".join(urls), lb.host, lb.port,
+            )
+            try:
+                lb.serve_forever()
+            except KeyboardInterrupt:
+                lb.stop()
+            return 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            deadline = time.time() + 10
+            for p in procs:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            for f in logs:
+                f.close()

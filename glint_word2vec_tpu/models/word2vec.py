@@ -1490,10 +1490,16 @@ class Word2VecModel:
         return self.engine
 
     def _decode_hits(self, sims, idx) -> List[Tuple[str, float]]:
+        # Non-finite scores are masked filler, never results: the
+        # exact path's -inf entries ride padding-row ids (>= vocab
+        # size, caught by the index check), but the ANN path's empty
+        # member slots carry id 0 — a REAL word — so dropping by score
+        # is the only filter that covers both (and a -inf would also
+        # serialize as invalid JSON).
         return [
             (self.vocab.words[int(i)], float(s))
             for s, i in zip(sims, idx)
-            if int(i) < self.vocab.size
+            if int(i) < self.vocab.size and np.isfinite(s)
         ]
 
     def find_synonyms_vector(
@@ -1511,18 +1517,38 @@ class Word2VecModel:
         return self._decode_hits(sims, idx)
 
     def find_synonyms_batch(
-        self, vectors: np.ndarray, num: int
+        self, vectors: np.ndarray, num: int, *, approximate: bool = False
     ) -> List[List[Tuple[str, float]]]:
         """Top-``num`` neighbors for a whole (Q, d) query batch in one
         distributed dispatch — the batch form of
         :meth:`find_synonyms_vector` (the reference answers findSynonyms
-        for arrays by looping single queries, ml:375-420)."""
+        for arrays by looping single queries, ml:375-420).
+        ``approximate=True`` rides the engine's two-stage coarse index
+        (ISSUE 12) instead of the exact masked GEMM — requires an
+        adopted index; the serving layer owns the recall gate. A
+        ``num`` beyond the index's probe capacity (nprobe x member
+        slots — thousands at the default geometry) silently routes to
+        the exact path: correctness outranks the speedup there."""
         if num <= 0:
             raise ValueError("num must be > 0")
         num = min(num, self.vocab.size)
-        sims, idx = self._query_engine().top_k_cosine_batch(
-            np.asarray(vectors, np.float32), num
-        )
+        eng = self._query_engine()
+        if approximate:
+            idx_obj = eng.ann_index
+            conf = getattr(eng, "_ann_conf", None) or {}
+            cap = (
+                conf.get("nprobe", 0) * idx_obj.slots
+                if idx_obj is not None else 0
+            )
+            approximate = num <= cap
+        if approximate:
+            sims, idx = eng.ann_top_k_batch(
+                np.asarray(vectors, np.float32), num
+            )
+        else:
+            sims, idx = eng.top_k_cosine_batch(
+                np.asarray(vectors, np.float32), num
+            )
         return [self._decode_hits(s, i) for s, i in zip(sims, idx)]
 
     def analogy(

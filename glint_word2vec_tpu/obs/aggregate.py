@@ -259,6 +259,28 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         "last_checkpoint_age_seconds": None,
         "checkpoint_write_seconds": None,
     }
+    # ANN index block (ISSUE 12): counters sum; the recall and its
+    # gate fold to the WORST replica (min recall, all-gates-pass) —
+    # the actionable fleet numbers; ages/staleness fold to the
+    # stalest.
+    index = {
+        "enabled": False,
+        "replicas_with_index": 0,
+        "clusters": None,
+        "member_slots": None,
+        "nprobe": None,
+        "build_seconds": None,
+        "last_refresh_age_seconds": None,
+        "refreshes_total": 0,
+        "recall_at10": None,
+        "recall_gate_ok": None,
+        "recall_gate_threshold": None,
+        "ann_queries_total": 0,
+        "probes_total": 0,
+        "probes_per_query": None,
+        "exact_fallbacks": {},
+        "table_versions_behind": None,
+    }
     for s in snaps:
         for size, n in (s.get("coalesced_batch_sizes") or {}).items():
             batches[size] = batches.get(size, 0) + int(n)
@@ -285,6 +307,46 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
                 # checkpoint and the slowest write are the actionable
                 # fleet numbers.
                 ck[k] = v if ck[k] is None else max(ck[k], v)
+        si = s.get("index") or {}
+        if si.get("enabled"):
+            index["enabled"] = True
+            index["replicas_with_index"] += 1
+            for k in ("clusters", "member_slots", "nprobe",
+                      "recall_gate_threshold"):
+                if si.get(k) is not None:
+                    index[k] = si[k]
+            index["refreshes_total"] += int(si.get("refreshes_total") or 0)
+            index["ann_queries_total"] += int(
+                si.get("ann_queries_total") or 0
+            )
+            index["probes_total"] += int(si.get("probes_total") or 0)
+            for reason, n in (si.get("exact_fallbacks") or {}).items():
+                index["exact_fallbacks"][reason] = (
+                    index["exact_fallbacks"].get(reason, 0) + int(n)
+                )
+            r = si.get("recall_at10")
+            if r is not None:
+                index["recall_at10"] = (
+                    r if index["recall_at10"] is None
+                    else min(index["recall_at10"], r)
+                )
+            g = si.get("recall_gate_ok")
+            if g is not None:
+                index["recall_gate_ok"] = (
+                    bool(g) if index["recall_gate_ok"] is None
+                    else (index["recall_gate_ok"] and bool(g))
+                )
+            for k in ("build_seconds", "last_refresh_age_seconds",
+                      "table_versions_behind"):
+                v = si.get(k)
+                if v is not None:
+                    index[k] = (
+                        v if index[k] is None else max(index[k], v)
+                    )
+    if index["ann_queries_total"]:
+        index["probes_per_query"] = round(
+            index["probes_total"] / index["ann_queries_total"], 2
+        )
     return {
         "replicas": len(snaps),
         "endpoints": {p: endpoints[p] for p in sorted(endpoints)},
@@ -295,6 +357,7 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         "overload": over,
         "compiles": compiles,
         "checkpoint": ck,
+        "index": index,
     }
 
 

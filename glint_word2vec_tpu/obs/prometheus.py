@@ -419,6 +419,138 @@ def serving_to_prometheus(snap: dict) -> str:
            "(NaN when it never has).")
     p.sample("glint_serving_last_checkpoint_age_seconds", None,
              ck.get("last_checkpoint_age_seconds"))
+    # ANN index family (ISSUE 12): the serving-side view of the
+    # two-stage approximate top-k — build/refresh lifecycle, the
+    # measured recall gate, and per-query probe accounting.
+    index = snap.get("index") or {}
+    p.head("glint_index_enabled", "gauge",
+           "Whether a device-resident ANN index is built for this "
+           "server (1) or every query is exact (0).")
+    p.sample("glint_index_enabled", None,
+             1 if index.get("enabled") else 0)
+    p.head("glint_index_clusters", "gauge",
+           "Coarse k-means clusters in the ANN index.")
+    p.sample("glint_index_clusters", None, index.get("clusters"))
+    p.head("glint_index_nprobe", "gauge",
+           "Clusters probed per approximate query.")
+    p.sample("glint_index_nprobe", None, index.get("nprobe"))
+    p.head("glint_index_build_seconds", "gauge",
+           "Wall seconds of the most recent index build/refresh.")
+    p.sample("glint_index_build_seconds", None,
+             index.get("build_seconds"))
+    p.head("glint_index_last_refresh_age_seconds", "gauge",
+           "Seconds since the index was last built or refreshed (NaN "
+           "before any build).")
+    p.sample("glint_index_last_refresh_age_seconds", None,
+             index.get("last_refresh_age_seconds"))
+    p.head("glint_index_refreshes_total", "counter",
+           "Index builds/refreshes (boot + one per hot-swap).")
+    p.sample("glint_index_refreshes_total", None,
+             index.get("refreshes_total", 0))
+    p.head("glint_index_recall_at10", "gauge",
+           "Measured recall@10 of the approximate path vs the exact "
+           "path on the same tables (NaN before any measurement).")
+    p.sample("glint_index_recall_at10", None, index.get("recall_at10"))
+    p.head("glint_index_recall_gate_ok", "gauge",
+           "Whether the last recall measurement cleared the gate (a "
+           "failing gate holds the exact path live).")
+    p.sample("glint_index_recall_gate_ok", None,
+             1 if index.get("recall_gate_ok") else 0)
+    p.head("glint_index_probes_per_query", "gauge",
+           "Mean clusters probed per approximate query (NaN before "
+           "any approximate query).")
+    p.sample("glint_index_probes_per_query", None,
+             index.get("probes_per_query"))
+    p.head("glint_index_ann_queries_total", "counter",
+           "Synonym queries answered through the ANN index.")
+    p.sample("glint_index_ann_queries_total", None,
+             index.get("ann_queries_total", 0))
+    p.head("glint_index_probes_total", "counter",
+           "Total clusters probed across all approximate queries.")
+    p.sample("glint_index_probes_total", None,
+             index.get("probes_total", 0))
+    p.head("glint_index_exact_fallbacks_total", "counter",
+           "Queries served by the exact path while the index is "
+           "enabled, by reason (requested = per-request exact=true; "
+           "gate = recall gate holding the approximate path back).")
+    for reason, n in sorted((index.get("exact_fallbacks") or {}).items()):
+        p.sample("glint_index_exact_fallbacks_total",
+                 {"reason": reason}, n)
+    p.head("glint_index_table_versions_behind", "gauge",
+           "Table mutations since the index was built against the "
+           "live tables (staleness; NaN without an index).")
+    p.sample("glint_index_table_versions_behind", None,
+             index.get("table_versions_behind"))
+    return p.text()
+
+
+# ----------------------------------------------------------------------
+# Fleet balancer exposition (fleet.LoadBalancer.metrics_doc)
+# ----------------------------------------------------------------------
+
+
+def fleet_to_prometheus(doc: dict) -> str:
+    """Render a fleet balancer document: replica liveness and proxy
+    accounting per replica, the balancer's retry/exhaustion counters,
+    and each replica's index recall gauges (fleet-prefixed and labeled
+    by replica — this text is concatenated with
+    ``serving_to_prometheus`` over the merged ``fleet`` member, and
+    families in one scrape must be disjoint). One scrape of the
+    balancer therefore carries the fleet totals AND the per-replica
+    recall-gate states."""
+    p = _Prom()
+    replicas = doc.get("replicas") or []
+    p.head("glint_fleet_replicas", "gauge",
+           "Serving replicas configured behind the balancer.")
+    p.sample("glint_fleet_replicas", None, len(replicas))
+    p.head("glint_fleet_replica_up", "gauge",
+           "Whether the replica answered the last metrics scrape.")
+    for r in replicas:
+        p.sample("glint_fleet_replica_up", {"replica": r.get("url", "")},
+                 1 if r.get("up") else 0)
+    p.head("glint_fleet_proxied_total", "counter",
+           "Requests the balancer forwarded, by replica.")
+    for r in replicas:
+        p.sample("glint_fleet_proxied_total",
+                 {"replica": r.get("url", "")},
+                 r.get("proxied_total", 0))
+    p.head("glint_fleet_proxy_errors_total", "counter",
+           "Forward attempts that failed at the connection level, by "
+           "replica.")
+    for r in replicas:
+        p.sample("glint_fleet_proxy_errors_total",
+                 {"replica": r.get("url", "")},
+                 r.get("proxy_errors_total", 0))
+    bal = doc.get("balancer") or {}
+    p.head("glint_fleet_shed_retries_total", "counter",
+           "Requests retried on another replica after a 429/503 shed "
+           "(the replicas' own backpressure steering the spread).")
+    p.sample("glint_fleet_shed_retries_total", None,
+             bal.get("shed_retries_total", 0))
+    p.head("glint_fleet_exhausted_total", "counter",
+           "Requests every replica shed or failed — the shed response "
+           "was relayed to the client.")
+    p.sample("glint_fleet_exhausted_total", None,
+             bal.get("exhausted_total", 0))
+    # Per-replica index recall: the fleet view of the ISSUE 12 recall
+    # gate (fleet-prefixed names — this exposition is concatenated
+    # with serving_to_prometheus over the merged doc, and families in
+    # one scrape must be disjoint).
+    p.head("glint_fleet_index_recall_at10", "gauge",
+           "Per-replica measured recall@10 of the approximate path vs "
+           "the exact path (NaN before any measurement).")
+    p.head("glint_fleet_index_recall_gate_ok", "gauge",
+           "Per-replica recall-gate verdict (a failing gate holds "
+           "that replica's exact path live).")
+    for r in replicas:
+        index = (r.get("snapshot") or {}).get("index") or {}
+        if not index.get("enabled"):
+            continue
+        label = {"replica": r.get("url", "")}
+        p.sample("glint_fleet_index_recall_at10", label,
+                 index.get("recall_at10"))
+        p.sample("glint_fleet_index_recall_gate_ok", label,
+                 1 if index.get("recall_gate_ok") else 0)
     return p.text()
 
 

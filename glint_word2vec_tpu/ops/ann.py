@@ -1,0 +1,659 @@
+"""Device-resident two-stage approximate top-k (IVF) for serving.
+
+The exact ``/synonyms`` path scores every vocabulary row per query — an
+O(V·d) masked GEMM whose cost grows with exactly the thing PR 11's bf16
+tables doubled (vocab per chip). This module is the sub-linear
+replacement (ROADMAP item 2): the pSGNScc batching discipline
+(arXiv:1611.06172) applied to the query side — per-query row sweeps
+become small dense GEMMs over a learned coarse structure.
+
+Stage A — coarse quantizer: spherical k-means centroids trained
+ON-DEVICE from the live table by a few jitted GEMM sweeps (fixed
+iteration count, fixed block shapes — the whole build is compile-once;
+rebuilds reuse every program because tables/centroids arrive as traced
+ARGUMENTS, never closures). A query's coarse scores ``q @ centroids.T``
+pick its ``nprobe`` clusters.
+
+Stage B — exact rerank inside the probed clusters: cluster members live
+in a padded ``(C, L)`` layout whose slot count ``L`` is a FIXED function
+of the engine's row capacity (``member_slots``), so every rebuild — and
+every hot-swapped generation — lands in the same compiled shapes.
+Clusters larger than ``L`` spill their overflow members to the next-best
+cluster with space (total capacity is ~2x the table, so packing always
+succeeds); a spilled row costs a little recall, never correctness, and
+``nprobe == C`` degenerates to the exact masked top-k (every member slot
+scored — the property the parity tests pin).
+
+Per query the work is ``C·d`` (coarse) + ``nprobe·L·d`` (rerank) —
+O(√V·d)-ish at the default geometry (``auto_clusters`` ≈ √V) versus
+``V·d`` exact.
+
+The index is a value (:class:`AnnIndex`): build against any table
+(live or staged), then flip it in together with the tables under the
+serving device lock — the swap-native lifecycle (ISSUE 12). Incremental
+maintenance (:func:`add_rows` / :func:`remove_rows` / :func:`update_rows`)
+re-buckets ONLY touched rows — the streaming-promotion path — by editing
+small host masters and re-staging the ``(C, L)`` device arrays.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glint_word2vec_tpu.utils import next_pow2
+
+#: Row-block width of the k-means assignment/update sweeps and of the
+#: full-table assignment pass: bounds the (block, C) score matrix on
+#: device, and fixes the sweep's compiled shapes for any sample size.
+ASSIGN_BLOCK = 8192
+
+#: Fixed chunk of the incremental (re-)assignment path: promotion
+#: bursts re-bucket in padded chunks of this many rows, so a lifetime
+#: of arbitrary burst sizes compiles exactly one assign program.
+INCREMENTAL_BLOCK = 256
+
+#: Member-slot headroom: total index capacity is ``~SLOT_FACTOR x`` the
+#: table's row count, split evenly across clusters. 1.5 keeps rerank
+#: cost low while leaving enough slack that k-means imbalance spills
+#: only the tail of oversized clusters.
+SLOT_FACTOR = 1.5
+
+
+def auto_clusters(num_rows: int) -> int:
+    """Default cluster count: next power of two at or above sqrt(rows)
+    (the O(√V·d) operating point), floored so tiny tables still get a
+    real two-stage structure."""
+    return max(4, next_pow2(math.ceil(math.sqrt(max(1, num_rows)))))
+
+
+def member_slots(num_rows: int, clusters: int) -> int:
+    """Padded member slots per cluster. A FIXED function of the
+    engine's ROW CAPACITY (``num_rows`` = vocab + extra rows) and the
+    cluster count — never of an actual cluster census — so streaming
+    growth and index rebuilds can never change a compiled shape."""
+    return max(8, next_pow2(math.ceil(SLOT_FACTOR * num_rows / clusters)))
+
+
+# ----------------------------------------------------------------------
+# Jitted program factories (module-level cache, shapes in the key; all
+# array state arrives as traced arguments so rebuilt indexes and
+# hot-swapped tables reuse every compiled program)
+# ----------------------------------------------------------------------
+
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _program(key, build):
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        fn = _PROGRAMS[key] = build()
+    return fn
+
+
+def _kmeans_sweep_fn(S: int, C: int, d: int):
+    """One spherical k-means iteration over the (S, d) normalized
+    sample: blocked assignment GEMMs + segment-sum update, centroids
+    re-normalized; empty clusters keep their previous centroid. The
+    sample weight vector masks padding rows out of the update."""
+
+    def build():
+        def sweep(xn, w, cent):
+            xb = xn.reshape(S // ASSIGN_BLOCK, ASSIGN_BLOCK, d)
+            wb = w.reshape(S // ASSIGN_BLOCK, ASSIGN_BLOCK)
+
+            def body(carry, xw):
+                sums, counts = carry
+                x, wt = xw
+                a = jnp.argmax(x @ cent.T, axis=1)  # (B,)
+                sums = sums + jax.ops.segment_sum(
+                    x * wt[:, None], a, num_segments=C
+                )
+                counts = counts + jax.ops.segment_sum(
+                    wt, a, num_segments=C
+                )
+                return (sums, counts), None
+
+            (sums, counts), _ = jax.lax.scan(
+                body,
+                (jnp.zeros((C, d), jnp.float32), jnp.zeros((C,), jnp.float32)),
+                (xb, wb),
+            )
+            nrm = jnp.linalg.norm(sums, axis=1, keepdims=True)
+            fresh = sums / jnp.where(nrm > 0, nrm, 1.0)
+            keep = (counts > 0)[:, None] & (nrm > 0)
+            return jnp.where(keep, fresh, cent)
+
+        return jax.jit(sweep)
+
+    return _program(("kmeans_sweep", S, C, d), build)
+
+
+def _assign_fn(B: int, C: int, d: int):
+    """Assign ``B`` table rows (by id) to their best centroid: gather,
+    normalize (zero-norm rows score 0 everywhere — their assignment is
+    meaningless and the packer skips them), one (B, C) GEMM, argmax."""
+
+    def build():
+        def assign(syn0, norms, ids, cent):
+            x = syn0[ids].astype(jnp.float32)[:, :d]
+            n = norms[ids]
+            xn = x * jnp.where(n > 0, 1.0 / jnp.where(n > 0, n, 1.0), 0.0)[
+                :, None
+            ]
+            return jnp.argmax(xn @ cent.T, axis=1).astype(jnp.int32)
+
+        return jax.jit(assign)
+
+    return _program(("ann_assign", B, C, d), build)
+
+
+def _score_fn(B: int, C: int, d: int):
+    """Full (B, C) centroid scores for ``B`` rows — the spill path's
+    preference order."""
+
+    def build():
+        def score(syn0, norms, ids, cent):
+            x = syn0[ids].astype(jnp.float32)[:, :d]
+            n = norms[ids]
+            xn = x * jnp.where(n > 0, 1.0 / jnp.where(n > 0, n, 1.0), 0.0)[
+                :, None
+            ]
+            return xn @ cent.T
+
+        return jax.jit(score)
+
+    return _program(("ann_score", B, C, d), build)
+
+
+def _search_fn(Q: int, k: int, nprobe: int, C: int, L: int, d: int):
+    """The two-stage query program: coarse top-``nprobe`` over the
+    centroid GEMM, then exact masked rerank inside the probed
+    clusters' PADDED MEMBER BLOCKS — ``member_rows[pid]`` gathers
+    ``nprobe`` contiguous (L, d) blocks per query (whole-slice
+    gathers, not per-row ones: XLA CPU scalar-izes a 4096-row gather
+    into ~2x the whole rerank's cost, and on TPU a block is one DMA),
+    and the scoring is a batched dense (P·L, d) x (d,) contraction —
+    the "small dense GEMMs over a learned coarse structure" the issue
+    names.
+
+    Masking mirrors the exact path's ``_mask_terms`` contract exactly:
+    a slot scores ``dot * inv_norm`` with ``-inf`` wherever it must not
+    surface — empty slots and zero-norm rows (``member_invn == 0``) and
+    rows at/past the traced ``n_queryable`` bound (freed extra rows
+    between index refreshes). ``n_queryable`` being traced means vocab
+    growth/shrink never recompiles a warmed program (the PR 2/10
+    contract, extended to the approximate path)."""
+
+    def build():
+        def search(member_rows, cent, members, invn, q, nq):
+            coarse = q @ cent.T  # (Q, C)
+            _, pid = jax.lax.top_k(coarse, nprobe)  # (Q, nprobe)
+            blocks = member_rows[pid].astype(jnp.float32)
+            dots = jnp.matmul(
+                blocks.reshape(Q, nprobe * L, d), q[:, :, None]
+            )[:, :, 0]  # (Q, P*L)
+            cand = members[pid].reshape(Q, nprobe * L)
+            inv = invn[pid].reshape(Q, nprobe * L)
+            ok = (inv > 0) & (cand < nq)
+            scores = dots * inv + jnp.where(ok, 0.0, -jnp.inf)
+            val, pos = jax.lax.top_k(scores, k)
+            return val, jnp.take_along_axis(cand, pos, axis=1)
+
+        return jax.jit(search)
+
+    return _program(("ann_search", Q, k, nprobe, C, L, d), build)
+
+
+def _gather_blocks_fn(C: int, L: int):
+    """Materialize the (C, L, d) member-block layout from the table —
+    the one big per-row gather, paid ONCE per build/refresh, off the
+    request path."""
+
+    def build():
+        def gather(syn0, members):
+            return jnp.take(syn0, members.reshape(-1), axis=0).reshape(
+                C, L, syn0.shape[1]
+            )
+
+        return jax.jit(gather)
+
+    return _program(("ann_gather_blocks", C, L), build)
+
+
+def _refresh_cluster_fn(L: int):
+    """Refresh ONE cluster's member block from the live table after an
+    incremental layout edit (promotion burst / free back-fill): a
+    fixed-shape (L, d) gather + one block set, cluster id traced."""
+
+    def build():
+        def refresh(member_rows, syn0, row_ids, c):
+            return member_rows.at[c].set(
+                syn0[row_ids].astype(member_rows.dtype)
+            )
+
+        return jax.jit(refresh)
+
+    return _program(("ann_refresh_cluster", L), build)
+
+
+# ----------------------------------------------------------------------
+# The index value
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class AnnIndex:
+    """A built coarse index over one table generation.
+
+    Device state (replicated): ``centroids`` (C, dp) f32 row-normalized,
+    ``members`` (C, L) int32 global row ids (0 in empty slots),
+    ``member_invn`` (C, L) f32 reciprocal row norms (0 marks an empty
+    slot OR a zero-norm row — either way the slot can never surface),
+    and ``member_rows`` (C, L, dp) in TABLE dtype — the padded
+    cluster-member block layout the rerank scores against (a
+    ``SLOT_FACTOR``-sized copy of its generation's table, which is
+    also what makes a served response single-generation by
+    construction: the index never reads the live table at query time).
+
+    Host masters mirror the member layout so incremental updates edit
+    in place and re-stage only the small id/norm arrays plus the
+    touched clusters' blocks; per-row ``cluster_of``/``slot_of`` make
+    removal O(1) per touched row.
+    """
+
+    clusters: int
+    slots: int
+    dim: int
+    centroids: jax.Array
+    members: jax.Array
+    member_invn: jax.Array
+    member_rows: jax.Array
+    members_np: np.ndarray
+    invn_np: np.ndarray
+    fill: np.ndarray  # (C,) live members per cluster
+    cluster_of: np.ndarray  # (num_rows,) int32, -1 = not indexed
+    slot_of: np.ndarray  # (num_rows,) int32
+    table_version: int
+    build_seconds: float
+    built_rows: int  # queryable rows at build time
+    sampled_rows: int
+    spilled_rows: int
+    iters: int
+    updated_rows: int = 0  # incrementally re-bucketed since build
+    _sharding: object = field(default=None, repr=False)
+
+    def stats(self) -> dict:
+        """Host-side summary for the serving ``index_*`` gauge family
+        (every field is already a host scalar)."""
+        return {
+            "clusters": self.clusters,
+            "member_slots": self.slots,
+            "build_seconds": round(self.build_seconds, 3),
+            "built_rows": self.built_rows,
+            "sampled_rows": self.sampled_rows,
+            "spilled_rows": self.spilled_rows,
+            "updated_rows": self.updated_rows,
+            "kmeans_iters": self.iters,
+            "table_version": self.table_version,
+        }
+
+    def _restage(self) -> None:
+        """Push the edited host member masters back to device (same
+        shapes — warmed search programs are reused as-is)."""
+        self.members = jax.device_put(
+            jnp.asarray(self.members_np), self._sharding
+        )
+        self.member_invn = jax.device_put(
+            jnp.asarray(self.invn_np), self._sharding
+        )
+
+
+# ----------------------------------------------------------------------
+# Build
+# ----------------------------------------------------------------------
+
+
+def _pack_members(
+    assign: np.ndarray,
+    inv: np.ndarray,
+    live_ids: np.ndarray,
+    C: int,
+    L: int,
+    pref_scores,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """Pack assigned rows into the fixed (C, L) slot layout, spilling
+    the overflow of oversized clusters to their next-best cluster with
+    space (``pref_scores(ids) -> (n, C)`` supplies preference rows for
+    exactly the spilled ids). Returns the host masters + spill count.
+
+    The non-spill majority places vectorized (stable argsort + rank
+    within cluster) — a per-row Python loop here runs per index build
+    AND per hot-swap staging, which at multi-million-row vocabs would
+    stall generation adoption by itself; only the rare spill tail pays
+    per-row work."""
+    num_rows_bound = int(live_ids.max()) + 1 if live_ids.size else 1
+    members = np.zeros((C, L), np.int32)
+    invn = np.zeros((C, L), np.float32)
+    cluster_of = np.full(num_rows_bound, -1, np.int32)
+    slot_of = np.zeros(num_rows_bound, np.int32)
+
+    order = np.argsort(assign, kind="stable")
+    c_o = assign[order].astype(np.int64)
+    counts = np.bincount(c_o, minlength=C)
+    starts = np.zeros(C + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    # Rank of each row inside its cluster (stable order): rows ranked
+    # past L are the spill tail.
+    ranks = np.arange(order.size, dtype=np.int64) - starts[c_o]
+    fit = ranks < L
+    rows_o = live_ids[order].astype(np.int64)
+    members[c_o[fit], ranks[fit]] = rows_o[fit]
+    invn[c_o[fit], ranks[fit]] = inv[order][fit]
+    cluster_of[rows_o[fit]] = c_o[fit]
+    slot_of[rows_o[fit]] = ranks[fit]
+    fill = np.minimum(counts, L)
+    spilled = order[~fit]
+    if spilled.size:
+        sp = np.asarray(spilled, np.int64)
+        scores = pref_scores(live_ids[sp])  # (n_spill, C)
+        pref = np.argsort(-scores, axis=1)
+        for row, pos in enumerate(sp):
+            rid = int(live_ids[pos])
+            placed = False
+            for c in pref[row]:
+                c = int(c)
+                if fill[c] < L:
+                    s = int(fill[c])
+                    members[c, s] = rid
+                    invn[c, s] = inv[pos]
+                    cluster_of[rid] = c
+                    slot_of[rid] = s
+                    fill[c] += 1
+                    placed = True
+                    break
+            # Total capacity C*L >= SLOT_FACTOR * rows > rows, so some
+            # cluster always has space.
+            assert placed, "ANN member capacity exhausted"
+    return members, invn, fill, cluster_of, slot_of, len(spilled)
+
+
+def build(
+    syn0,
+    norms,
+    queryable: int,
+    *,
+    clusters: Optional[int] = None,
+    nprobe_hint: int = 8,
+    iters: int = 6,
+    sample: int = 65536,
+    seed: int = 0,
+    table_version: int = 0,
+    num_rows: Optional[int] = None,
+    sharding=None,
+) -> AnnIndex:
+    """Train centroids on-device from ``syn0`` and pack the member
+    layout. ``syn0``/``norms`` may be the LIVE tables or a STAGED
+    generation's — nothing here reads or writes engine state, which is
+    what makes the index swap-native. ``num_rows`` fixes the slot
+    geometry (defaults to ``queryable``; pass the engine's full row
+    capacity so streaming growth keeps shapes stable).
+
+    Host syncs below (sampling ids, the assignment readback, member
+    packing) all run OFF the request path by contract: build/refresh
+    happens at boot or on the staging thread of a hot-swap.
+    """
+    t0 = time.perf_counter()
+    V = int(queryable)
+    capacity = int(num_rows if num_rows is not None else V)
+    C = int(clusters) if clusters else auto_clusters(capacity)
+    L = member_slots(capacity, C)
+    dp = int(syn0.shape[1])
+    d = dp  # centroids live at padded width; query vectors arrive padded
+
+    norms_np = np.asarray(norms, np.float32)[:V]
+    live_ids = np.flatnonzero(norms_np > 0).astype(np.int32)
+    inv_all = np.zeros(V, np.float32)
+    inv_all[live_ids] = 1.0 / norms_np[live_ids]
+
+    rng = np.random.default_rng(seed)
+    S_raw = min(int(sample), live_ids.size)
+    if live_ids.size and S_raw:
+        sample_ids = (
+            live_ids
+            if S_raw == live_ids.size
+            else rng.choice(live_ids, S_raw, replace=False).astype(np.int32)
+        )
+    else:
+        sample_ids = np.zeros(1, np.int32)
+        S_raw = 0
+    S = max(ASSIGN_BLOCK, next_pow2(max(1, S_raw)))
+    ids_pad = np.zeros(S, np.int32)
+    ids_pad[:S_raw] = sample_ids[:S_raw]
+    w = np.zeros(S, np.float32)
+    w[:S_raw] = 1.0
+
+    # Normalized sample matrix, built by one device gather (same shapes
+    # as the assignment sweeps), then the fixed-iteration sweep loop —
+    # one compiled program however many iterations run.
+    xg = np.asarray(
+        syn0[jnp.asarray(ids_pad)].astype(jnp.float32)
+    )[:, :d]
+    xn = xg * (inv_all[ids_pad] * w)[:, None]
+
+    # Deterministic init: centroids from evenly strided sample rows
+    # (already normalized); degenerate tables fall back to unit e0.
+    if S_raw >= C:
+        init = xn[np.linspace(0, S_raw - 1, C).astype(np.int64)]
+    else:
+        init = np.zeros((C, d), np.float32)
+        init[:S_raw] = xn[:S_raw]
+    zero = np.linalg.norm(init, axis=1) == 0
+    if zero.any():
+        fallback = np.zeros(d, np.float32)
+        fallback[0] = 1.0
+        init[zero] = fallback
+
+    sweep = _kmeans_sweep_fn(S, C, d)
+    xn_dev = jnp.asarray(xn)
+    w_dev = jnp.asarray(w)
+    cent = jnp.asarray(init)
+    for _ in range(max(1, int(iters))):
+        cent = sweep(xn_dev, w_dev, cent)
+
+    # Full-table assignment: every live row, in fixed ASSIGN_BLOCK
+    # chunks (compile-once), argmax readback per chunk.
+    afn = _assign_fn(ASSIGN_BLOCK, C, d)
+    assign = np.zeros(live_ids.size, np.int32)
+    for s in range(0, live_ids.size, ASSIGN_BLOCK):
+        chunk = live_ids[s : s + ASSIGN_BLOCK]
+        n = chunk.shape[0]
+        if n < ASSIGN_BLOCK:
+            chunk = np.concatenate(
+                [chunk, np.zeros(ASSIGN_BLOCK - n, np.int32)]
+            )
+        out = np.asarray(
+            afn(syn0, norms, jnp.asarray(chunk), cent)
+        )
+        assign[s : s + n] = out[:n]
+
+    sfn = _score_fn(INCREMENTAL_BLOCK, C, d)
+
+    def pref_scores(ids: np.ndarray) -> np.ndarray:
+        out = np.zeros((ids.size, C), np.float32)
+        for s in range(0, ids.size, INCREMENTAL_BLOCK):
+            chunk = ids[s : s + INCREMENTAL_BLOCK].astype(np.int32)
+            n = chunk.shape[0]
+            if n < INCREMENTAL_BLOCK:
+                chunk = np.concatenate(
+                    [chunk, np.zeros(INCREMENTAL_BLOCK - n, np.int32)]
+                )
+            out[s : s + n] = np.asarray(
+                sfn(syn0, norms, jnp.asarray(chunk), cent)
+            )[:n]
+        return out
+
+    inv_live = inv_all[live_ids]
+    members, invn, fill, cluster_of, slot_of, n_spill = _pack_members(
+        assign, inv_live, live_ids, C, L, pref_scores
+    )
+    # Per-row maps sized to the full capacity so later promotions index
+    # directly.
+    cap = max(capacity, cluster_of.shape[0])
+    cof = np.full(cap, -1, np.int32)
+    sof = np.zeros(cap, np.int32)
+    cof[: cluster_of.shape[0]] = cluster_of
+    sof[: slot_of.shape[0]] = slot_of
+
+    idx = AnnIndex(
+        clusters=C,
+        slots=L,
+        dim=d,
+        centroids=jax.device_put(cent, sharding),
+        members=None,
+        member_invn=None,
+        member_rows=None,
+        members_np=members,
+        invn_np=invn,
+        fill=fill,
+        cluster_of=cof,
+        slot_of=sof,
+        table_version=int(table_version),
+        build_seconds=0.0,
+        built_rows=V,
+        sampled_rows=int(S_raw),
+        spilled_rows=int(n_spill),
+        iters=int(iters),
+        _sharding=sharding,
+    )
+    idx._restage()
+    # The block layout: one big gather from the SOURCE table (live or
+    # staged), off the request path by contract.
+    idx.member_rows = _gather_blocks_fn(C, L)(syn0, idx.members)
+    idx.build_seconds = time.perf_counter() - t0
+    return idx
+
+
+# ----------------------------------------------------------------------
+# Incremental maintenance (streaming promotions / frees / row writes)
+# ----------------------------------------------------------------------
+
+
+def _refresh_clusters(index: AnnIndex, syn0, clusters) -> None:
+    """Re-materialize the member blocks of ONLY the touched clusters
+    from the table — the incremental path never rebuilds the (C, L, d)
+    layout wholesale."""
+    if not clusters:
+        return
+    fn = _refresh_cluster_fn(index.slots)
+    rows = index.member_rows
+    for c in sorted(clusters):
+        rows = fn(
+            rows, syn0, jnp.asarray(index.members_np[c]), jnp.int32(c)
+        )
+    index.member_rows = rows
+
+
+def add_rows(index: AnnIndex, syn0, norms, ids: Sequence[int]) -> int:
+    """Bucket newly written/promoted rows into the live layout — ONLY
+    these rows move; everything else (centroids, every other member
+    slot, every untouched cluster block) is untouched. Rows are
+    assigned to their best centroid with space (preference order from
+    one small fixed-shape score GEMM per INCREMENTAL_BLOCK chunk).
+    Zero-norm rows are skipped (they can never surface). Returns the
+    number of rows actually inserted."""
+    ids = np.asarray(list(ids), np.int64)
+    if ids.size == 0:
+        return 0
+    norms_host = np.asarray(norms, np.float32)
+    sfn = _score_fn(INCREMENTAL_BLOCK, index.clusters, index.dim)
+    inserted = 0
+    touched: set = set()
+    for s in range(0, ids.size, INCREMENTAL_BLOCK):
+        chunk = ids[s : s + INCREMENTAL_BLOCK]
+        n = chunk.shape[0]
+        padded = np.zeros(INCREMENTAL_BLOCK, np.int32)
+        padded[:n] = chunk
+        scores = np.asarray(
+            sfn(syn0, norms, jnp.asarray(padded), index.centroids)
+        )[:n]
+        pref = np.argsort(-scores, axis=1)
+        for row, rid in enumerate(chunk):
+            rid = int(rid)
+            if rid >= index.cluster_of.shape[0]:
+                continue  # beyond the indexed row capacity (bucket rows)
+            if index.cluster_of[rid] >= 0:
+                _drop_row(index, rid, touched)
+            nr = norms_host[rid]
+            if nr <= 0:
+                continue
+            for c in pref[row]:
+                c = int(c)
+                if index.fill[c] < index.slots:
+                    slot = int(index.fill[c])
+                    index.members_np[c, slot] = rid
+                    index.invn_np[c, slot] = 1.0 / nr
+                    index.cluster_of[rid] = c
+                    index.slot_of[rid] = slot
+                    index.fill[c] += 1
+                    inserted += 1
+                    touched.add(c)
+                    break
+    if inserted or ids.size:
+        index.updated_rows += int(ids.size)
+        index._restage()
+        _refresh_clusters(index, syn0, touched)
+    return inserted
+
+
+def _drop_row(index: AnnIndex, rid: int, touched: set) -> None:
+    """Remove one row from its slot, back-filling with the cluster's
+    last member so the live prefix stays dense."""
+    c = int(index.cluster_of[rid])
+    if c < 0:
+        return
+    s = int(index.slot_of[rid])
+    last = int(index.fill[c]) - 1
+    if s != last:
+        mover = int(index.members_np[c, last])
+        index.members_np[c, s] = mover
+        index.invn_np[c, s] = index.invn_np[c, last]
+        index.slot_of[mover] = s
+    index.members_np[c, last] = 0
+    index.invn_np[c, last] = 0.0
+    index.fill[c] = last
+    index.cluster_of[rid] = -1
+    touched.add(c)
+
+
+def remove_rows(index: AnnIndex, syn0, ids: Sequence[int]) -> int:
+    """Drop rows from the layout (freed extra rows). The traced
+    ``n_queryable`` bound already hides them from searches the moment
+    the engine shrinks; this keeps the layout dense and the slots
+    reusable (the back-filled slots' blocks refresh from ``syn0``).
+    Returns the number of rows removed."""
+    removed = 0
+    touched: set = set()
+    for rid in ids:
+        rid = int(rid)
+        if 0 <= rid < index.cluster_of.shape[0] and \
+                index.cluster_of[rid] >= 0:
+            _drop_row(index, rid, touched)
+            removed += 1
+    if removed:
+        index.updated_rows += removed
+        index._restage()
+        _refresh_clusters(index, syn0, touched)
+    return removed
+
+
+def update_rows(index: AnnIndex, syn0, norms, ids: Sequence[int]) -> int:
+    """Re-bucket rows whose VALUES changed (write_rows): drop + re-add
+    with fresh norms/assignments. Touched rows only."""
+    return add_rows(index, syn0, norms, ids)

@@ -229,6 +229,12 @@ TOPK_MIN_K_BUCKET = 16
 #: low-concurrency shape, served by the bandwidth-bound matvec.
 TOPK_MIN_Q_BUCKET = 8
 
+#: Per-dispatch query cap of the approximate top-k path: the rerank
+#: gathers (Q, nprobe * slots, d) rows, so Q is chunked to bound the
+#: transient at ~tens of MB regardless of the serving coalescer's
+#: max_batch. Buckets {1, 8, 16} cover every chunk.
+ANN_MAX_Q = 16
+
 
 def _rank1_payload(cpos_g, cneg_g, C: int, n: int):
     """(coefs, hidx) for the fused rank-1 scatter, matching the update
@@ -1262,6 +1268,11 @@ class EmbeddingEngine:
         # derived from table values without holding device buffers.
         self._norms_cache = None
         self.table_version = 0
+        #: Device-resident coarse index for approximate top-k (ISSUE
+        #: 12): built via configure_ann()+ann_build(), flipped live by
+        #: adopt_ann() — None keeps every query exact.
+        self._ann = None
+        self._ann_conf = None
         #: Spare extra rows claimed for runtime vocabulary growth
         #: (ISSUE 10 streaming): rows [vocab_size, vocab_size +
         #: extra_rows_assigned) hold words assigned online via
@@ -1820,6 +1831,7 @@ class EmbeddingEngine:
             rows = jnp.pad(rows, ((0, 0), (0, pad)))
         self.syn0 = fn(self.syn0, rows, jnp.int32(start_row))
         self._tick_tables("write_rows")
+        self._ann_touch_rows(range(start_row, start_row + rows.shape[0]))
 
     # ------------------------------------------------------------------
     # Runtime vocabulary growth (ISSUE 10 streaming)
@@ -1919,6 +1931,7 @@ class EmbeddingEngine:
             left -= m
         self.extra_rows_assigned += n
         self._tick_tables("assign_extra_row")
+        self._ann_touch_rows(range(start, start + n))
         obs_events.emit(
             "extra_rows_assigned", start=start, n=n,
             assigned=self.extra_rows_assigned, words=words[:8],
@@ -1956,10 +1969,31 @@ class EmbeddingEngine:
         self.syn1 = fn(self.syn1, zeros, jnp.int32(start))
         self.extra_rows_assigned -= n
         self._tick_tables("free_extra_rows")
+        if self._ann is not None:
+            from glint_word2vec_tpu.ops import ann as _ann_mod
+
+            _ann_mod.remove_rows(
+                self._ann, self.syn0, range(start, start + n)
+            )
+            self._ann.table_version = self.table_version
         obs_events.emit(
             "extra_rows_freed", freed=n, assigned=self.extra_rows_assigned,
         )
         return n
+
+    def _ann_touch_rows(self, rows) -> None:
+        """Incrementally re-bucket rows whose values just changed into
+        the live coarse index (streaming promotions / row writes):
+        ONLY the touched rows move — the ISSUE 12 incremental
+        re-assignment contract. A no-op without an adopted index; the
+        index version advances with the table so staleness gauges stay
+        honest."""
+        if self._ann is None:
+            return
+        from glint_word2vec_tpu.ops import ann as _ann_mod
+
+        _ann_mod.update_rows(self._ann, self.syn0, self.norms(), rows)
+        self._ann.table_version = self.table_version
 
     def set_noise_counts(self, counts: np.ndarray) -> None:
         """Install updated per-word corpus counts and rebuild the
@@ -2098,6 +2132,298 @@ class EmbeddingEngine:
             vals.append(np.asarray(val)[:n, :kk])
             idxs.append(np.asarray(idx)[:n, :kk])
         return np.concatenate(vals), np.concatenate(idxs)
+
+    # ------------------------------------------------------------------
+    # Approximate top-k (device-resident ANN index, ISSUE 12)
+    # ------------------------------------------------------------------
+
+    def configure_ann(
+        self,
+        *,
+        clusters: int = -1,
+        nprobe: int = 8,
+        iters: int = 6,
+        sample: int = 65536,
+    ) -> dict:
+        """Fix the coarse-index geometry for this engine. ``clusters``
+        -1 picks ``ops.ann.auto_clusters`` (≈ next_pow2(√rows) — the
+        O(√V·d) operating point); the member-slot count follows from
+        the engine's FULL row capacity, so streaming growth and every
+        later rebuild share one compiled shape family. Returns the
+        resolved geometry."""
+        from glint_word2vec_tpu.ops import ann as _ann
+
+        clusters = int(clusters)  # graftlint: ignore[sync-point] host config scalar
+        nprobe = int(nprobe)  # graftlint: ignore[sync-point] host config scalar
+        iters = int(iters)  # graftlint: ignore[sync-point] host config scalar
+        sample = int(sample)  # graftlint: ignore[sync-point] host config scalar
+        C = clusters if clusters > 0 else _ann.auto_clusters(self.num_rows)
+        self._ann_conf = {
+            "clusters": C,
+            "slots": _ann.member_slots(self.num_rows, C),
+            "nprobe": max(1, min(nprobe, C)),
+            "iters": max(1, iters),
+            "sample": max(1, sample),
+        }
+        return dict(self._ann_conf)
+
+    @property
+    def ann_index(self):
+        """The adopted live index, or None."""
+        return getattr(self, "_ann", None)
+
+    def ann_build(self, syn0=None, norms=None, queryable=None):
+        """Build a coarse index (k-means centroids + packed member
+        layout) from ``syn0`` — the LIVE table by default, or a STAGED
+        generation's (pass its arrays) so a hot-swap can prepare the
+        index entirely off the request path. Returns the index WITHOUT
+        adopting it; flip it live with :meth:`adopt_ann` (the serving
+        swap does both under one device-lock hold). Requires
+        :meth:`configure_ann` first."""
+        from glint_word2vec_tpu.ops import ann as _ann
+
+        conf = getattr(self, "_ann_conf", None)
+        if conf is None:
+            raise RuntimeError("call configure_ann() before ann_build()")
+        if syn0 is None:
+            syn0 = self.syn0
+            norms = self.norms()
+            queryable = self.queryable_rows
+        elif norms is None:
+            norms = self._norms(syn0)
+        if queryable is None:
+            queryable = self.queryable_rows
+        queryable = int(queryable)  # graftlint: ignore[sync-point] host row-count scalar
+        return _ann.build(
+            syn0,
+            norms,
+            queryable,
+            clusters=conf["clusters"],
+            iters=conf["iters"],
+            sample=conf["sample"],
+            seed=self._seed,
+            table_version=self.table_version,
+            num_rows=self.num_rows,
+            sharding=NamedSharding(self.mesh, P()),
+        )
+
+    def adopt_ann(self, index) -> None:
+        """Flip the live coarse index: one attribute assignment — the
+        serving hot-swap pairs it with :meth:`adopt_tables` under the
+        same device-lock hold so tables and index always flip together.
+        ``None`` disables the approximate path."""
+        self._ann = index
+        if index is not None:
+            index.table_version = self.table_version
+
+    def ann_stats(self) -> dict:
+        """Index telemetry for the serving ``index_*`` family; safe to
+        call with no index (reports disabled)."""
+        idx = self.ann_index
+        if idx is None:
+            return {"enabled": False}
+        st = idx.stats()
+        st["enabled"] = True
+        st["nprobe"] = self._ann_conf["nprobe"]
+        st["table_versions_behind"] = max(
+            0, self.table_version - idx.table_version
+        )
+        return st
+
+    def ann_top_k_batch(
+        self, vecs, k: int, nprobe: Optional[int] = None, *, index=None,
+        queryable=None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Approximate :meth:`top_k_cosine_batch` through the coarse
+        index: coarse centroid scores pick ``nprobe`` clusters per
+        query, exact masked rerank inside their padded member-row
+        blocks. Same bucketing contract as the exact path (Q padded to
+        its power-of-two bucket capped at ``ANN_MAX_Q``, k rounded to
+        its bucket and truncated), so serving concurrency jitter rides
+        one small warmed family. The search reads ONLY the index (the
+        member blocks are a copy of the index's source table), so
+        ``index``/``queryable`` overrides run staged-generation recall
+        checks on the very same compiled programs the live path uses."""
+        from glint_word2vec_tpu.ops import ann as _ann
+
+        idx = index if index is not None else self.ann_index
+        if idx is None:
+            raise RuntimeError("no ANN index adopted (ann_build/adopt_ann)")
+        if queryable is None:
+            queryable = self.queryable_rows
+        if nprobe is None:
+            nprobe = self._ann_conf["nprobe"]
+        nprobe = max(1, min(int(nprobe), idx.clusters))
+        if not 0 < k <= self.padded_vocab:
+            raise ValueError(f"k must be in [1, {self.padded_vocab}]")
+        if k > nprobe * idx.slots:
+            # The probed slots cannot hold k candidates — a silent
+            # truncation would diverge from the exact path with no
+            # signal. Callers (the model layer) route oversized k to
+            # the exact path instead.
+            raise ValueError(
+                f"k={k} exceeds the index's probe capacity "
+                f"({nprobe} probes x {idx.slots} slots); raise nprobe "
+                "or use the exact path"
+            )
+        q = np.asarray(vecs, dtype=np.float32)
+        if q.ndim != 2 or q.shape[1] != self.dim:
+            raise ValueError(f"vecs must have shape (Q, {self.dim})")
+        nrm = np.linalg.norm(q, axis=1, keepdims=True)
+        q = q / np.where(nrm > 0, nrm, 1.0)
+        kk = min(k, self.padded_vocab)
+        if q.shape[0] == 0:
+            empty = np.zeros((0, kk))
+            return empty.astype(np.float32), empty.astype(np.int64)
+        k_b = min(self._k_bucket(k), nprobe * idx.slots)  # bucket pad only
+        vals, idxs = [], []
+        for s in range(0, q.shape[0], ANN_MAX_Q):
+            qc = q[s : s + ANN_MAX_Q]
+            n = qc.shape[0]
+            q_b = min(self._q_bucket(n), ANN_MAX_Q)
+            if q_b != n:
+                qc = np.concatenate(
+                    [qc, np.zeros((q_b - n, qc.shape[1]), np.float32)]
+                )
+            fn = _ann._search_fn(
+                q_b, k_b, nprobe, idx.clusters, idx.slots, idx.dim
+            )
+            self._count_query_shape("ann_topk", q_b, k_b, nprobe)
+            val, ids = fn(
+                idx.member_rows, idx.centroids, idx.members,
+                idx.member_invn, self._pad_query(qc),
+                jnp.int32(queryable),
+            )
+            vals.append(np.asarray(val)[:n, :kk])
+            idxs.append(np.asarray(ids)[:n, :kk])
+        return np.concatenate(vals), np.concatenate(idxs)
+
+    def warmup_ann(self, q_buckets=(1, 8, ANN_MAX_Q),
+                   k_buckets=(TOPK_MIN_K_BUCKET,),
+                   nprobes=()) -> int:
+        """Compile the approximate dispatch family — coarse score +
+        bucketed rerank for every (Q bucket, k bucket, nprobe), plus
+        the incremental-assignment program promotions ride — so the
+        serving warmup covers the ANN path too and
+        ``post_warmup_compiles`` stays 0 (ISSUE 12 satellite). Requires
+        an adopted index."""
+        from glint_word2vec_tpu.ops import ann as _ann
+
+        idx = self.ann_index
+        if idx is None:
+            raise RuntimeError("adopt an index before warmup_ann()")
+        before = self.query_compiles
+        # Buckets arrive as host int tuples from the serving warmup.
+        nps = sorted(
+            {max(1, min(p, idx.clusters))
+             for p in (*nprobes, self._ann_conf["nprobe"])}
+        )
+        d = self.dim
+        with obs_events.span("engine_warmup_ann"):
+            for p in nps:
+                for q in sorted(
+                    {min(self._q_bucket(q), ANN_MAX_Q)
+                     for q in q_buckets}
+                ):
+                    for k in sorted(
+                        {self._k_bucket(k) for k in k_buckets}
+                    ):
+                        self.ann_top_k_batch(
+                            np.zeros((q, d), np.float32), k, p
+                        )
+            # The promotion path's fixed-chunk assignment program.
+            _ann._score_fn(
+                _ann.INCREMENTAL_BLOCK, idx.clusters, idx.dim
+            )(
+                self.syn0, self.norms(),
+                jnp.zeros(_ann.INCREMENTAL_BLOCK, jnp.int32),
+                idx.centroids,
+            )
+        n = self.query_compiles - before
+        obs_events.emit("warmup_ann_done", shapes_compiled=n)
+        return n
+
+    def ann_recall_at_k(
+        self, k: int = 10, sample: int = 64, nprobe: Optional[int] = None,
+        *, index=None, syn0=None, norms=None, queryable=None,
+        q_chunk: int = 64,
+    ) -> float:
+        """Measured recall@k of the approximate path against the exact
+        path on the SAME tables (live by default; pass a staged
+        generation's arrays to gate a hot-swap before adopting it).
+        Queries are ``sample`` deterministic table rows; for each, the
+        exact and approximate top-(k+1) sets are compared with the
+        query row itself excluded — the serving ``/synonyms``
+        semantics. Both sides ride the already-warmed bucketed
+        programs (``q_chunk`` should be the serving max_batch), so a
+        post-warmup recall check never compiles."""
+        idx = index if index is not None else self.ann_index
+        if idx is None:
+            raise RuntimeError("no ANN index adopted")
+        if syn0 is None:
+            syn0 = self.syn0
+            norms = self.norms()
+            queryable = self.queryable_rows
+        elif norms is None:
+            norms = self._norms(syn0)
+        if queryable is None:
+            queryable = self.queryable_rows
+        queryable = int(queryable)
+        rng = np.random.default_rng(self._seed)
+        n_q = min(int(sample), queryable)
+        if n_q == 0:
+            return 1.0
+        qids = rng.choice(queryable, n_q, replace=False).astype(np.int32)
+        qvecs = np.asarray(
+            syn0[jnp.asarray(qids)].astype(jnp.float32)
+        )[:, : self.dim]
+        live = np.linalg.norm(qvecs, axis=1) > 0
+        if not live.any():
+            return 1.0
+        qids, qvecs = qids[live], qvecs[live]
+        k_b = self._k_bucket(k + 1)
+        if k_b not in self._topk_batch_cache:
+            self._topk_batch_cache[k_b] = self._make_topk_batch(k_b)
+        exact_fn = self._topk_batch_cache[k_b]
+        hits = 0
+        total = 0
+        for s in range(0, qids.shape[0], q_chunk):
+            qc = qvecs[s : s + q_chunk]
+            ic = qids[s : s + q_chunk]
+            n = qc.shape[0]
+            nrm = np.linalg.norm(qc, axis=1, keepdims=True)
+            qn = qc / np.where(nrm > 0, nrm, 1.0)
+            q_b = self._q_bucket(n)
+            qp = qn
+            if q_b != n:
+                qp = np.concatenate(
+                    [qn, np.zeros((q_b - n, qn.shape[1]), np.float32)]
+                )
+            self._count_query_shape("topk_batch", q_b, k_b)
+            ex_val, ex_idx = exact_fn(
+                syn0, self._pad_query(qp), norms, jnp.int32(queryable)
+            )
+            ex_val = np.asarray(ex_val)[:n]
+            ex_idx = np.asarray(ex_idx)[:n]
+            ap_val, ap_idx = self.ann_top_k_batch(
+                qc, k + 1, nprobe, index=idx, queryable=queryable,
+            )
+            for row in range(n):
+                # -inf entries are masked filler (padding rows, empty
+                # member slots) surfacing only when fewer than k+1 rows
+                # are queryable — they are NOT results on either side.
+                ex = [
+                    int(i) for i, v in zip(ex_idx[row], ex_val[row])
+                    if np.isfinite(v) and int(i) != int(ic[row])
+                ]
+                ap = {
+                    int(i) for i, v in zip(ap_idx[row], ap_val[row])
+                    if np.isfinite(v) and int(i) != int(ic[row])
+                }
+                want = ex[:k]
+                hits += len(set(want) & ap)
+                total += len(want)
+        return hits / max(1, total)
 
     def warmup(
         self,
@@ -2759,6 +3085,7 @@ class EmbeddingEngine:
         self._corpus = None
         self._corpus_compacted = None
         self._keep_prob = None
+        self._ann = None
         self._tick_tables("destroy")
 
     @property

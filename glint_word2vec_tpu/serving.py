@@ -217,6 +217,19 @@ class _SynonymCoalescer:
         self.cache_size = max(0, int(cache_size))
         self._cache: dict = {}
         self._cache_version = None
+        #: Mode supplier installed by the server once the ANN index is
+        #: built and gated: True = default requests ride the
+        #: approximate path. Requests carrying ``exact=true`` always
+        #: take the exact path (the escape hatch); cache keys carry
+        #: the mode so the two paths never serve each other's results.
+        self.ann_active = lambda: False
+        #: nprobe the server resolved for the approximate path (for
+        #: the probes/query accounting).
+        self.ann_nprobe = 0
+        #: True while an index EXISTS but the recall gate is holding
+        #: the approximate path back — those exact serves are counted
+        #: as gate fallbacks, not user-requested ones.
+        self.gate_failing = lambda: False
         self.can_batch = (
             isinstance(model, Word2VecModel)
             and type(model).find_synonyms is Word2VecModel.find_synonyms
@@ -237,18 +250,19 @@ class _SynonymCoalescer:
             timeout=deadline - time.monotonic()
         )
 
-    def cache_lookup(self, word, num):
+    def cache_lookup(self, word, num, exact: bool = False):
         """Result-cache probe with NO device work — the degraded
         cache-only mode's read path. Returns the cached hit list or
         None; never blocks on the device lock."""
         if word is None or not self.cache_size:
             return None
+        mode = "exact" if (exact or not self.ann_active()) else "ann"
         with self._mu:
             self._cache_sync_locked()
-            return self._cache.get((word, int(num)))
+            return self._cache.get((word, int(num), mode))
 
     def query(self, word=None, vector=None, num: int = 10,
-              deadline: Optional[float] = None):
+              deadline: Optional[float] = None, exact: bool = False):
         if not self.can_batch:
             # Overriding families define their own semantics end to end
             # (FastText OOV-by-subwords, its own num validation).
@@ -273,10 +287,14 @@ class _SynonymCoalescer:
                 if num == 0:
                     return []
             raise ValueError("num must be > 0")
+        # Mode resolves ONCE at enqueue (not at dispatch): a gate flip
+        # mid-wait must not hand a request a mode its cache key and
+        # accounting never saw.
+        mode = "exact" if (exact or not self.ann_active()) else "ann"
         if word is not None and self.cache_size:
             with self._mu:
                 self._cache_sync_locked()
-                hit = self._cache.get((word, num))
+                hit = self._cache.get((word, num, mode))
             if self.metrics is not None:
                 self.metrics.record_cache(hit is not None)
             if hit is not None:
@@ -285,6 +303,7 @@ class _SynonymCoalescer:
             "word": word, "vector": vector, "num": int(num),
             "event": threading.Event(), "result": None, "error": None,
             "deadline": deadline, "abandoned": False,
+            "mode": mode, "exact_requested": bool(exact),
         }
         with self._mu:
             self._pending.append(req)
@@ -403,8 +422,14 @@ class _SynonymCoalescer:
                 continue
             live.append(r)
         try:
-            for s in range(0, len(live), self.max_batch):
-                self._dispatch(live[s : s + self.max_batch])
+            # A drained batch can mix modes (per-request exact=true
+            # riding alongside approximate defaults): each mode group
+            # is its own dispatch — the approximate and exact programs
+            # are different compiled families.
+            for mode in ("ann", "exact"):
+                group = [r for r in live if r.get("mode", "exact") == mode]
+                for s in range(0, len(group), self.max_batch):
+                    self._dispatch(group[s : s + self.max_batch], mode)
         except Exception as e:  # pragma: no cover - device failure path
             for r in live:
                 if r["error"] is None and r["result"] is None:
@@ -413,9 +438,10 @@ class _SynonymCoalescer:
             for r in live:
                 r["event"].set()
 
-    def _dispatch(self, chunk) -> None:
+    def _dispatch(self, chunk, mode: str = "exact") -> None:
         """Answer one <= max_batch slice of the drained batch with one
-        bucketed pull + one bucketed batch top-k dispatch."""
+        bucketed pull + one bucketed batch top-k dispatch (exact masked
+        GEMM, or the two-stage coarse+rerank when ``mode == "ann"``)."""
         faults.fire("serving.dispatch")
         m = self.model
         # Version BEFORE the reads: if a table mutation lands mid-
@@ -434,10 +460,29 @@ class _SynonymCoalescer:
             r["num"] + (1 if r["word"] is not None else 0) for r in chunk
         )
         hits = m.find_synonyms_batch(
-            np.stack([r["vec"] for r in chunk]), min(k, m.vocab.size)
+            np.stack([r["vec"] for r in chunk]), min(k, m.vocab.size),
+            approximate=(mode == "ann"),
         )
         if self.metrics is not None:
             self.metrics.record_batch(len(chunk))
+            if mode == "ann":
+                self.metrics.record_ann_query(len(chunk), self.ann_nprobe)
+            elif self.ann_active() or self.gate_failing():
+                # Attribute per REQUEST, not from dispatch-time global
+                # state: an explicit exact=true is the escape hatch
+                # ("requested") even while the gate is failing; only
+                # defaults held back BY the gate count as "gate".
+                n_req = sum(
+                    1 for r in chunk if r.get("exact_requested")
+                )
+                if n_req:
+                    self.metrics.record_exact_fallback(
+                        n_req, "requested"
+                    )
+                if len(chunk) - n_req and self.gate_failing():
+                    self.metrics.record_exact_fallback(
+                        len(chunk) - n_req, "gate"
+                    )
         for r, hs in zip(chunk, hits):
             if r["word"] is not None:
                 hs = [(w, s) for w, s in hs if w != r["word"]]
@@ -450,7 +495,9 @@ class _SynonymCoalescer:
                     if r["word"] is not None:
                         while len(self._cache) >= self.cache_size:
                             self._cache.pop(next(iter(self._cache)))
-                        self._cache[(r["word"], r["num"])] = r["result"]
+                        self._cache[
+                            (r["word"], r["num"], mode)
+                        ] = r["result"]
 
 
 class SnapshotWatcher:
@@ -543,6 +590,12 @@ class ModelServer:
     on ``/metrics`` (and summarized on ``/healthz``).
     """
 
+    #: Lock-free by design: ``_ann_live`` is a single bool flag —
+    #: written at boot (no request threads yet) and under the device
+    #: lock on hot-swap, read by request threads where a stale read
+    #: only routes one request to the other (equally correct) path.
+    _ATOMIC_ATTRS = frozenset({"_ann_live"})
+
     def __init__(
         self,
         model,
@@ -562,6 +615,13 @@ class ModelServer:
         max_inflight: int = 256,
         request_deadline: Optional[float] = 30.0,
         degraded_after: Optional[float] = 5.0,
+        ann: bool = False,
+        ann_clusters: int = -1,
+        ann_nprobe: int = 8,
+        ann_iters: int = 6,
+        ann_sample: int = 65536,
+        ann_recall_gate: float = 0.95,
+        ann_recall_sample: int = 64,
     ):
         self.model = model
         self._prev_switch: Optional[float] = None
@@ -599,10 +659,41 @@ class ModelServer:
             cache_size=cache_size,
         )
         self.max_batch = self._coalescer.max_batch
+        # -- approximate top-k (ISSUE 12) ------------------------------
+        #: Whether the two-stage device index serves default /synonyms
+        #: traffic. Only the base word-level family (the batching
+        #: population) qualifies; per-request ``exact=true`` always
+        #: escapes to the exact masked GEMM, and the measured recall
+        #: gate can hold the approximate path back entirely.
+        self.ann = bool(ann) and self._coalescer.can_batch
+        self.ann_recall_gate = float(ann_recall_gate)
+        self.ann_recall_sample = max(1, int(ann_recall_sample))
+        self._ann_live = False
+        if self.ann:
+            eng = model.engine
+            conf = eng.configure_ann(
+                clusters=ann_clusters, nprobe=ann_nprobe,
+                iters=ann_iters, sample=ann_sample,
+            )
+            self._coalescer.ann_nprobe = conf["nprobe"]
+            if eng.ann_index is None:
+                t0 = time.time()
+                eng.adopt_ann(eng.ann_build())
+                logger.info(
+                    "ANN index built in %.1fs (%d clusters x %d slots)",
+                    time.time() - t0, conf["clusters"], conf["slots"],
+                )
         if warmup:
             self._warmup(
                 warm_ks, warm_sentence_lens, warm_sentence_rows
             )
+        if self.ann:
+            # Recall gate AFTER warmup: the check rides the warmed
+            # exact + approximate programs, so it proves the index AND
+            # costs zero compiles. A failing gate keeps the exact path
+            # serving (counted on /metrics as gate fallbacks) — a fast
+            # wrong answer is not an answer.
+            self._gate_index(self.model.engine, self.metrics.generation)
         # Shapes compiled from here on are serving-path misses the
         # /metrics "post_warmup" counter (and the CI smoke) watches.
         self.metrics.warmup_compiles = self._query_compiles()
@@ -678,12 +769,16 @@ class ModelServer:
                                     server.request_deadline,
                                 "degraded_after_seconds":
                                     server.degraded_after,
+                                "ann_enabled": server._ann_live,
+                                "ann_recall_gate_ok":
+                                    server.metrics.index_recall_gate_ok,
                             },
                         )
                     elif url.path == "/metrics":
                         snap = server.metrics.snapshot(
                             server._query_compiles(),
                             checkpoint=server._checkpoint_stats(),
+                            index_staleness=server._index_staleness(),
                         )
                         fmt = parse_qs(url.query).get("format", ["json"])[0]
                         if fmt == "prometheus":
@@ -811,7 +906,8 @@ class ModelServer:
                                 400, {"error": f"bad num: {e}"}
                             )
                         hit = server._coalescer.cache_lookup(
-                            req.get("word"), num
+                            req.get("word"), num,
+                            exact=bool(req.get("exact", False)),
                         )
                         if hit is not None:
                             server.metrics.record_cache(True)
@@ -837,6 +933,7 @@ class ModelServer:
                                 word=req["word"],
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
+                                exact=bool(req.get("exact", False)),
                             )
                         ]
                     elif path == "/synonyms_vector":
@@ -846,6 +943,7 @@ class ModelServer:
                                 vector=req["vector"],
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
+                                exact=bool(req.get("exact", False)),
                             )
                         ]
                     else:
@@ -880,6 +978,59 @@ class ModelServer:
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         self.watcher: Optional[SnapshotWatcher] = None
+        # Mode suppliers LAST: no request thread exists yet, and the
+        # coalescer must never see ann before the gate ran.
+        self._coalescer.ann_active = lambda: self._ann_live
+        self._coalescer.gate_failing = (
+            lambda: self.ann and not self._ann_live
+        )
+
+    # -- approximate index lifecycle (ISSUE 12) ------------------------
+
+    def _gate_index(self, engine, generation, *, index=None, syn0=None,
+                    norms=None, queryable=None):
+        """Measure recall@10 of the approximate path against the exact
+        path on the SAME tables (live, or a staged generation's) and
+        record the refresh on /metrics. For the LIVE index this also
+        flips ``_ann_live``; for a staged one the caller adopts the
+        verdict together with the tables. Returns (recall, gate_ok)."""
+        eng_conf = engine._ann_conf or {}
+        recall = engine.ann_recall_at_k(
+            10, sample=self.ann_recall_sample, index=index, syn0=syn0,
+            norms=norms, queryable=queryable, q_chunk=self.max_batch,
+        )
+        ok = recall >= self.ann_recall_gate
+        stats = (
+            engine.ann_stats() if index is None
+            else {**index.stats(), "enabled": True}
+        )
+        self.metrics.record_index_refresh(
+            stats, recall, ok, self.ann_recall_gate,
+            eng_conf.get("nprobe", 0),
+        )
+        if index is None:
+            self._ann_live = ok
+        if not ok:
+            logger.warning(
+                "ANN recall gate FAILED (%.3f < %.3f)%s: exact path "
+                "keeps serving",
+                recall, self.ann_recall_gate,
+                f" for {generation}" if generation else "",
+            )
+        else:
+            logger.info(
+                "ANN recall gate ok: %.3f >= %.3f", recall,
+                self.ann_recall_gate,
+            )
+        return recall, ok
+
+    def _index_staleness(self) -> Optional[int]:
+        """Table versions the live index is behind (None = no index)."""
+        eng = getattr(self.model, "engine", None)
+        idx = getattr(eng, "ann_index", None)
+        if eng is None or idx is None:
+            return None
+        return max(0, eng.table_version - idx.table_version)
 
     # -- hot-swap (ISSUE 10) ------------------------------------------
 
@@ -906,14 +1057,19 @@ class ModelServer:
         directory (a model dir: ``matrix/`` + ``words.txt``).
 
         Staging — manifest verification, disk reads, building the
-        re-sharded device arrays — runs on the calling thread with NO
-        lock held, concurrent with live dispatches against the old
-        tables. The flip is two attribute assignments + one
-        ``table_version`` tick under the device lock: in-flight
-        dispatches drain first (no response mixes generations), the
-        synonym result cache empties wholesale, and the same-shape
-        tables reuse every warmed compiled program (zero post-warmup
-        compiles — the PR 2 contract, preserved across swaps)."""
+        re-sharded device arrays, and (with the index enabled)
+        training the new generation's centroids, packing its member
+        layout, and measuring its recall gate — runs on the calling
+        thread with NO lock held, concurrent with live dispatches
+        against the old tables. The flip is a few attribute
+        assignments + one ``table_version`` tick under the device
+        lock: in-flight dispatches drain first (no response mixes
+        generations — the index flips WITH the tables, so a coarse
+        probe can never rank one generation's members against
+        another's vectors), the synonym result cache empties
+        wholesale, and the same-shape tables AND index reuse every
+        warmed compiled program (zero post-warmup compiles — the PR 2
+        contract, preserved across swaps on both paths)."""
         from glint_word2vec_tpu.corpus.vocab import saved_model_vocabulary
         from glint_word2vec_tpu.models.word2vec import Word2VecModel
 
@@ -932,13 +1088,35 @@ class ModelServer:
                 meta.get("extra_rows_assigned", 0)
             ),
         )
+        staged_ann = None
+        staged_ok = False
+        if self.ann:
+            # Refresh the coarse index against the STAGED tables — new
+            # centroids, fresh member packing, and the recall gate all
+            # run off the request path; only the flip below is held.
+            staged_q = int(meta["vocab_size"]) + int(
+                meta.get("extra_rows_assigned", 0)
+            )
+            staged_norms = engine._norms(staged["syn0"])
+            staged_ann = engine.ann_build(
+                staged["syn0"], staged_norms, staged_q
+            )
+            _, staged_ok = self._gate_index(
+                engine, generation, index=staged_ann,
+                syn0=staged["syn0"], norms=staged_norms,
+                queryable=staged_q,
+            )
         with self._lock:
             engine.adopt_tables(staged)
             self.model.vocab = vocab
+            if staged_ann is not None:
+                engine.adopt_ann(staged_ann)
+                self._ann_live = staged_ok
         self.metrics.record_swap(generation, ok=True)
         logger.info(
-            "hot-swapped to %s (%d words, table_version %d)",
+            "hot-swapped to %s (%d words, table_version %d%s)",
             generation or gen_dir, len(vocab.words), engine.table_version,
+            ", index refreshed" if staged_ann is not None else "",
         )
 
     # -- overload protection ------------------------------------------
@@ -1028,10 +1206,20 @@ class ModelServer:
             sentence_lens=warm_sentence_lens,
             sentence_rows=warm_sentence_rows,
         )
+        if self.ann and self.model.engine.ann_index is not None:
+            # The approximate dispatch family (coarse score + bucketed
+            # rerank + the promotion-path assignment program) warms
+            # with the exact family, BEFORE the port binds — the
+            # zero-post-warmup-compiles contract covers both paths
+            # (ISSUE 12 satellite).
+            n += self.model.engine.warmup_ann(
+                q_buckets=q_buckets, k_buckets=warm_ks,
+            )
         logger.info(
             "serving warmup: %d shapes compiled in %.1fs "
-            "(Q buckets %s, k buckets %s)",
+            "(Q buckets %s, k buckets %s%s)",
             n, time.time() - t0, q_buckets, tuple(warm_ks),
+            ", +ann" if self.ann else "",
         )
 
     # -- request dispatch ---------------------------------------------
@@ -1108,13 +1296,24 @@ def serve_model_dir(
     degraded_after: Optional[float] = 5.0,
     watch_dir: Optional[str] = None,
     watch_poll: float = 1.0,
+    ann: bool = False,
+    ann_clusters: int = -1,
+    ann_nprobe: int = 8,
+    ann_iters: int = 6,
+    ann_sample: int = 65536,
+    ann_recall_gate: float = 0.95,
+    ann_recall_sample: int = 64,
+    port_file: Optional[str] = None,
 ) -> None:
     """Load a saved model (any family) and serve it until killed.
 
     ``watch_dir`` follows a streaming trainer's publish directory:
     ``model_dir=None`` then boots from its newest committed generation
     (waiting for the first one to appear), and every later generation
-    hot-swaps in under load."""
+    hot-swaps in under load. ``port_file`` writes the bound
+    ``{"host", "port"}`` atomically once the server is warmed and
+    listening — the fleet launcher's (and CI's) readiness barrier for
+    ``--port 0`` ephemeral replicas."""
     from glint_word2vec_tpu import load_model
 
     current = None
@@ -1170,9 +1369,19 @@ def serve_model_dir(
         max_batch=max_batch, warmup=warmup, cache_size=cache_size,
         max_inflight=max_inflight, request_deadline=request_deadline,
         degraded_after=degraded_after,
+        ann=ann, ann_clusters=ann_clusters, ann_nprobe=ann_nprobe,
+        ann_iters=ann_iters, ann_sample=ann_sample,
+        ann_recall_gate=ann_recall_gate,
+        ann_recall_sample=ann_recall_sample,
     )
     if watch_dir is not None:
         server.watch(watch_dir, poll_seconds=watch_poll, current=current)
+    if port_file:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(
+            port_file, {"host": server.host, "port": server.port}
+        )
     try:
         server.serve_forever()
     except KeyboardInterrupt:

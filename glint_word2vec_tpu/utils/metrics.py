@@ -397,6 +397,20 @@ class ServingMetrics:
         #: first swap names one — a freshly-loaded model predates the
         #: publish protocol's naming).
         self.generation: Optional[str] = None
+        # ANN index accounting (ISSUE 12): refresh/build telemetry set
+        # by the server on every index build (boot + each hot-swap),
+        # query-side counters fed by the coalescer's dispatches.
+        self.index_enabled = False
+        self.index_refreshes = 0
+        self.index_stats: dict = {}
+        self.index_recall_at10: Optional[float] = None
+        self.index_recall_gate_ok: Optional[bool] = None
+        self.index_recall_gate: Optional[float] = None
+        self.index_nprobe: Optional[int] = None
+        self.last_index_refresh_time: Optional[float] = None
+        self.ann_queries = 0
+        self.ann_probes = 0
+        self.exact_fallbacks: Dict[str, int] = {}
 
     #: Cap on distinct tracked endpoint paths: the key is the raw
     #: client-supplied request path, and without a bound a port scanner
@@ -472,8 +486,48 @@ class ServingMetrics:
             else:
                 self.swap_failures += 1
 
+    def record_index_refresh(self, stats: dict, recall: Optional[float],
+                             gate_ok: Optional[bool], gate: float,
+                             nprobe: int) -> None:
+        """One index build/refresh (boot or hot-swap staging): the
+        engine's ``ann_stats()`` dict plus the measured recall@10 of
+        the approximate path against the exact path on the same tables
+        and the pass/fail of the recall gate."""
+        with self._mu:
+            self.index_enabled = True
+            self.index_refreshes += 1
+            self.index_stats = dict(stats)
+            self.index_recall_at10 = (
+                # graftlint: ignore[sync-point] recall is a host float from the gate measurement
+                round(float(recall), 4) if recall is not None else None
+            )
+            self.index_recall_gate_ok = gate_ok
+            self.index_recall_gate = float(gate)  # graftlint: ignore[sync-point] host config scalar
+            self.index_nprobe = int(nprobe)  # graftlint: ignore[sync-point] host config scalar
+            self.last_index_refresh_time = time.time()
+
+    def record_ann_query(self, n: int, nprobe: int) -> None:
+        """``n`` queries answered through the coarse index, each
+        probing ``nprobe`` clusters."""
+        with self._mu:
+            self.ann_queries += int(n)  # graftlint: ignore[sync-point] host batch-size count
+            # graftlint: ignore[sync-point] host counts from the coalescer
+            self.ann_probes += int(n) * int(nprobe)
+
+    def record_exact_fallback(self, n: int, reason: str) -> None:
+        """``n`` queries served by the EXACT path while the index is
+        enabled: ``"requested"`` (the per-request ``exact=true`` escape
+        hatch) or ``"gate"`` (the recall gate is failing, so the server
+        held the approximate path back)."""
+        with self._mu:
+            self.exact_fallbacks[reason] = (
+                # graftlint: ignore[sync-point] host batch-size count
+                self.exact_fallbacks.get(reason, 0) + int(n)
+            )
+
     def snapshot(self, total_compiles: int = 0,
-                 checkpoint: Optional[dict] = None) -> dict:
+                 checkpoint: Optional[dict] = None,
+                 index_staleness: Optional[int] = None) -> dict:
         """``checkpoint`` is the engine's ``checkpoint_stats()`` dict
         (pending_async_saves / last_checkpoint_age_seconds /
         checkpoint_write_seconds); serving a freshly-loaded model reports
@@ -535,6 +589,31 @@ class ServingMetrics:
                     "checkpoint_write_seconds": (checkpoint or {}).get(
                         "checkpoint_write_seconds"
                     ),
+                },
+                "index": {
+                    "enabled": self.index_enabled,
+                    "clusters": self.index_stats.get("clusters"),
+                    "member_slots": self.index_stats.get("member_slots"),
+                    "nprobe": self.index_nprobe,
+                    "build_seconds": self.index_stats.get("build_seconds"),
+                    "spilled_rows": self.index_stats.get("spilled_rows"),
+                    "updated_rows": self.index_stats.get("updated_rows"),
+                    "refreshes_total": self.index_refreshes,
+                    "last_refresh_age_seconds": (
+                        round(time.time() - self.last_index_refresh_time, 2)
+                        if self.last_index_refresh_time else None
+                    ),
+                    "recall_at10": self.index_recall_at10,
+                    "recall_gate_ok": self.index_recall_gate_ok,
+                    "recall_gate_threshold": self.index_recall_gate,
+                    "ann_queries_total": self.ann_queries,
+                    "probes_total": self.ann_probes,
+                    "probes_per_query": (
+                        round(self.ann_probes / self.ann_queries, 2)
+                        if self.ann_queries else None
+                    ),
+                    "exact_fallbacks": dict(self.exact_fallbacks),
+                    "table_versions_behind": index_staleness,
                 },
             }
 

@@ -1,0 +1,400 @@
+"""Device-resident ANN top-k (ISSUE 12): build/search correctness, the
+recall gate, the compile-once shape family, incremental re-bucketing,
+and the serving integration (exact escape hatch, gate fallback,
+index metrics family)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.corpus.vocab import Vocabulary
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+from glint_word2vec_tpu.obs.aggregate import merge_serving_snapshots
+from glint_word2vec_tpu.obs.prometheus import (
+    fleet_to_prometheus,
+    lint_prometheus_text,
+    serving_to_prometheus,
+)
+from glint_word2vec_tpu.ops import ann
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.serving import ModelServer
+from glint_word2vec_tpu.utils.params import Word2VecParams
+
+V, D, EXTRA, TRUE_CLUSTERS = 1024, 16, 8, 32
+
+
+def _structured_rows(num_rows, seed=0, spread=0.25):
+    """Mixture-of-Gaussians table: real embedding spaces have coarse
+    cluster structure (that is WHY IVF works); neighbors of a row are
+    overwhelmingly its true-cluster peers."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((TRUE_CLUSTERS, D)).astype(np.float32)
+    return (
+        centers[rng.integers(0, TRUE_CLUSTERS, num_rows)]
+        + spread * rng.standard_normal((num_rows, D)).astype(np.float32)
+    )
+
+
+def _make_engine(rows=None, seed=1):
+    eng = EmbeddingEngine(
+        make_mesh(1, 1), V, D,
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+        seed=seed, extra_rows=EXTRA,
+    )
+    pts = _structured_rows(V) if rows is None else rows
+    full = np.concatenate([pts, np.zeros((EXTRA, D), np.float32)])
+    eng.set_tables(full, np.zeros_like(full))
+    return eng, pts
+
+
+@pytest.fixture(scope="module")
+def indexed_engine():
+    eng, pts = _make_engine()
+    eng.configure_ann(nprobe=8)
+    eng.adopt_ann(eng.ann_build())
+    eng.warmup_ann()
+    yield eng, pts
+    eng.destroy()
+
+
+def test_auto_geometry_fixed_by_capacity():
+    # Shapes depend only on row capacity + cluster count — the
+    # compile-once contract across rebuilds and streaming growth.
+    C = ann.auto_clusters(V + EXTRA)
+    assert C == 64  # next_pow2(ceil(sqrt(1032)))
+    assert ann.member_slots(V + EXTRA, C) == ann.member_slots(V + EXTRA, C)
+    assert ann.member_slots(V + EXTRA, C) >= (V + EXTRA) // C
+
+
+def test_nprobe_all_clusters_equals_exact(indexed_engine):
+    """nprobe == C degenerates to the exact masked top-k: every live
+    row sits in exactly one probed member slot."""
+    eng, pts = indexed_engine
+    q = pts[:8]
+    sims_a, ids_a = eng.ann_top_k_batch(q, 10, nprobe=eng.ann_index.clusters)
+    sims_e, ids_e = eng.top_k_cosine_batch(q, 10)
+    np.testing.assert_array_equal(ids_a, ids_e)
+    np.testing.assert_allclose(sims_a, sims_e, rtol=1e-5, atol=1e-6)
+
+
+def test_every_live_row_is_a_member_exactly_once(indexed_engine):
+    eng, _ = indexed_engine
+    idx = eng.ann_index
+    live = idx.members_np[idx.invn_np > 0]
+    assert live.size == V  # every vocab row, no duplicates
+    assert len(set(live.tolist())) == V
+    assert (idx.cluster_of[:V] >= 0).all()
+
+
+def test_recall_gate_passes_on_structured_table(indexed_engine):
+    eng, _ = indexed_engine
+    recall = eng.ann_recall_at_k(10, sample=64)
+    assert recall >= 0.95, recall
+
+
+def test_compile_once_across_rebuilds_and_shapes(indexed_engine):
+    """After warmup_ann, any Q (chunked at ANN_MAX_Q into the {1, 8,
+    16} bucket family) and any k <= the warmed bucket dispatches with
+    ZERO fresh compiles — including against a REBUILT index (rebuilds
+    reuse every program because arrays are arguments)."""
+    eng, pts = indexed_engine
+    before = eng.query_compiles
+    for Q in (1, 2, 5, 8, 16, 23, 40):
+        eng.ann_top_k_batch(pts[:Q], 10)
+    assert eng.query_compiles == before
+    eng.adopt_ann(eng.ann_build())  # rebuild: same shapes by geometry
+    eng.ann_top_k_batch(pts[:7], 12)
+    assert eng.query_compiles == before
+
+
+def test_incremental_promotion_rebuckets_only_touched(indexed_engine):
+    eng, _ = indexed_engine
+    idx = eng.ann_index
+    cluster_before = idx.cluster_of.copy()
+    updated_before = idx.updated_rows
+    compiles_before = eng.query_compiles
+    rows = eng.assign_extra_rows(["fresh1", "fresh2"])
+    # Only the promoted rows changed membership.
+    changed = np.flatnonzero(idx.cluster_of != cluster_before)
+    assert set(changed.tolist()) == set(rows)
+    assert idx.updated_rows == updated_before + len(rows)
+    # The promotion path rides the warmed assignment program.
+    assert eng.query_compiles == compiles_before
+    # The promoted row is immediately findable through the index.
+    vec = np.asarray(eng.pull(np.asarray(rows, np.int32)))[:1]
+    _, ids = eng.ann_top_k_batch(vec, 3)
+    assert ids[0, 0] == rows[0]
+    # Freeing removes exactly those rows from the layout.
+    eng.free_extra_rows()
+    assert (idx.cluster_of[rows] == -1).all()
+    _, ids = eng.ann_top_k_batch(vec, 3)
+    assert rows[0] not in set(ids[0].tolist())
+
+
+def test_spilled_packing_keeps_every_row():
+    """Packer unit test with a worst-case census: EVERY row assigned
+    to cluster 0 overflows it immediately — the overflow must land in
+    next-best clusters with space, every row exactly once."""
+    n, C, L = 64, 8, 16
+    live_ids = np.arange(n, dtype=np.int32)
+    assign = np.zeros(n, np.int32)  # all rows claim cluster 0
+    inv = np.ones(n, np.float32)
+    rng = np.random.default_rng(0)
+    pref = rng.standard_normal((n, C)).astype(np.float32)
+
+    members, invn, fill, cluster_of, slot_of, n_spill = ann._pack_members(
+        assign, inv, live_ids, C, L,
+        lambda ids: pref[ids],
+    )
+    assert n_spill == n - L  # everything past cluster 0's slots spilled
+    assert fill.sum() == n
+    assert fill[0] == L
+    live = members[invn > 0]
+    assert len(set(live.tolist())) == n == live.size
+    for rid in range(n):
+        c, s = cluster_of[rid], slot_of[rid]
+        assert members[c, s] == rid
+
+
+def test_sparse_probe_returns_no_filler(indexed_engine):
+    """A query probing fewer live candidates than k must return only
+    real results: empty member slots carry id 0 (a REAL word) with a
+    -inf score, and leaking one produced ["w0", -Infinity] — which is
+    also invalid JSON. _decode_hits drops non-finite scores."""
+    import json as _json
+
+    eng, pts = indexed_engine
+    vocab = Vocabulary.from_sorted(
+        [f"w{i}" for i in range(V)],
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+    )
+    model = Word2VecModel(vocab, eng, Word2VecParams(vector_size=D))
+    # nprobe=1 over one cluster (mean fill ~16 of 32 slots): ask for
+    # more than the probed cluster holds.
+    k = eng.ann_index.slots - 2
+    vals, ids = eng.ann_top_k_batch(pts[:2], k, nprobe=1)
+    assert (~np.isfinite(vals)).any(), "expected filler in raw output"
+    approx = [
+        model._decode_hits(v, i) for v, i in zip(vals, ids)
+    ]
+    for row in approx:
+        assert all(np.isfinite(s) for _, s in row), row
+        _json.dumps(row)  # must be serializable (no Infinity)
+    # At least one query probed a sparse cluster: fewer results than
+    # k, never fake ones.
+    assert any(len(row) < k for row in approx), [len(r) for r in approx]
+
+
+def test_oversized_k_falls_back_to_exact(indexed_engine):
+    """k beyond nprobe x slots cannot ride the index: the engine
+    refuses loudly, and the model layer routes the request to the
+    exact path (identical results, no silent truncation)."""
+    eng, pts = indexed_engine
+    cap = eng._ann_conf["nprobe"] * eng.ann_index.slots
+    with pytest.raises(ValueError, match="probe capacity"):
+        eng.ann_top_k_batch(pts[:2], cap + 1)
+    vocab = Vocabulary.from_sorted(
+        [f"w{i}" for i in range(V)],
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+    )
+    model = Word2VecModel(vocab, eng, Word2VecParams(vector_size=D))
+    big = min(cap + 10, V)
+    approx = model.find_synonyms_batch(pts[:1], big, approximate=True)
+    exact = model.find_synonyms_batch(pts[:1], big)
+    assert [w for w, _ in approx[0]] == [w for w, _ in exact[0]]
+    assert len(approx[0]) == len(exact[0])
+
+
+def test_merge_serving_snapshots_index_block():
+    def snap(recall, ok, queries, probes, stale):
+        return {
+            "endpoints": {}, "coalesced_batch_sizes": {},
+            "synonym_cache": {"hits": 0, "misses": 0},
+            "overload": {}, "compiles": {},
+            "index": {
+                "enabled": True, "clusters": 64, "member_slots": 32,
+                "nprobe": 8, "build_seconds": 1.0,
+                "last_refresh_age_seconds": stale * 2.0,
+                "refreshes_total": 1, "recall_at10": recall,
+                "recall_gate_ok": ok, "recall_gate_threshold": 0.95,
+                "ann_queries_total": queries, "probes_total": probes,
+                "exact_fallbacks": {"requested": 1},
+                "table_versions_behind": stale,
+            },
+        }
+
+    merged = merge_serving_snapshots(
+        [snap(0.99, True, 10, 80, 0), snap(0.90, False, 30, 240, 3)]
+    )
+    idx = merged["index"]
+    assert idx["enabled"] and idx["replicas_with_index"] == 2
+    assert idx["recall_at10"] == 0.90  # worst replica
+    assert idx["recall_gate_ok"] is False  # any failing gate fails
+    assert idx["ann_queries_total"] == 40
+    assert idx["probes_total"] == 320
+    assert idx["probes_per_query"] == 8.0
+    assert idx["exact_fallbacks"] == {"requested": 2}
+    assert idx["table_versions_behind"] == 3  # stalest
+    # The merged doc renders through the SAME serving renderer.
+    lint_prometheus_text(serving_to_prometheus(merged))
+
+
+def test_fleet_prometheus_renders_per_replica_recall():
+    doc = {
+        "replicas": [
+            {"url": "http://h:1", "up": True, "proxied_total": 5,
+             "proxy_errors_total": 0,
+             "snapshot": {"index": {"enabled": True, "recall_at10": 0.97,
+                                    "recall_gate_ok": True}}},
+            {"url": "http://h:2", "up": False, "proxied_total": 0,
+             "proxy_errors_total": 2},
+        ],
+        "balancer": {"shed_retries_total": 1, "exhausted_total": 0,
+                     "proxied_total": 5, "proxy_errors_total": 2},
+        "fleet": None,
+    }
+    text = fleet_to_prometheus(doc)
+    lint_prometheus_text(text)
+    assert 'glint_fleet_index_recall_at10{replica="http://h:1"} 0.97' \
+        in text
+    assert 'glint_fleet_replica_up{replica="http://h:2"} 0' in text
+
+
+# ----------------------------------------------------------------------
+# Serving integration
+# ----------------------------------------------------------------------
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def ann_server():
+    eng, pts = _make_engine(seed=4)
+    vocab = Vocabulary.from_sorted(
+        [f"w{i}" for i in range(V)],
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+    )
+    model = Word2VecModel(vocab, eng, Word2VecParams(vector_size=D))
+    server = ModelServer(
+        model, port=0, max_batch=16, cache_size=1024,
+        ann=True, ann_nprobe=8, ann_recall_sample=48,
+    )
+    server.start_background()
+    yield server, model
+    server.stop()
+    model.stop()
+
+
+def test_serving_ann_gate_and_family(ann_server):
+    server, model = ann_server
+    h = _get(server, "/healthz")
+    assert h["ann_enabled"] is True
+    assert h["ann_recall_gate_ok"] is True
+    assert h["post_warmup_compiles"] == 0
+
+
+def test_serving_exact_escape_hatch(ann_server):
+    server, model = ann_server
+    code, approx = _post(server, "/synonyms", {"word": "w7", "num": 5})
+    code2, exact = _post(
+        server, "/synonyms", {"word": "w7", "num": 5, "exact": True}
+    )
+    assert code == code2 == 200
+    # Same neighbors on a structured table (scores may differ in the
+    # last float ulp — reduction order).
+    assert [w for w, _ in approx] == [w for w, _ in exact]
+    snap = _get(server, "/metrics")
+    assert snap["index"]["exact_fallbacks"].get("requested", 0) >= 1
+    assert snap["index"]["ann_queries_total"] >= 1
+    assert snap["index"]["probes_per_query"] == 8.0
+
+
+def test_serving_cache_keys_are_mode_scoped(ann_server):
+    server, model = ann_server
+    _post(server, "/synonyms", {"word": "w9", "num": 4})
+    hits0 = _get(server, "/metrics")["synonym_cache"]["hits"]
+    # Same (word, num) under the OTHER mode must MISS (different key).
+    _post(server, "/synonyms", {"word": "w9", "num": 4, "exact": True})
+    snap = _get(server, "/metrics")
+    assert snap["synonym_cache"]["hits"] == hits0
+    # Repeat of the approximate query hits.
+    _post(server, "/synonyms", {"word": "w9", "num": 4})
+    assert _get(server, "/metrics")["synonym_cache"]["hits"] == hits0 + 1
+
+
+def test_serving_zero_compiles_after_traffic(ann_server):
+    server, model = ann_server
+    for num in (3, 10, 15):
+        for w in ("w1", "w2", "w3", "w500"):
+            _post(server, "/synonyms", {"word": w, "num": num})
+    h = _get(server, "/healthz")
+    assert h["post_warmup_compiles"] == 0
+    snap = _get(server, "/metrics")
+    text = serving_to_prometheus(snap)
+    lint_prometheus_text(text)
+    assert "glint_index_enabled 1" in text
+    assert "glint_index_refreshes_total 1" in text
+
+
+def test_failing_recall_gate_holds_exact_path():
+    """An impossible gate (> 1.0) must keep the exact path serving:
+    ann stays off, fallbacks count under reason=gate, and answers are
+    the exact path's."""
+    eng, pts = _make_engine(seed=5)
+    vocab = Vocabulary.from_sorted(
+        [f"w{i}" for i in range(V)],
+        np.arange(V, 0, -1, dtype=np.int64) + 4,
+    )
+    model = Word2VecModel(vocab, eng, Word2VecParams(vector_size=D))
+    server = ModelServer(
+        model, port=0, max_batch=8, ann=True, ann_recall_gate=1.01,
+        ann_recall_sample=16,
+    )
+    server.start_background()
+    try:
+        h = _get(server, "/healthz")
+        assert h["ann_enabled"] is False
+        assert h["ann_recall_gate_ok"] is False
+        code, _ = _post(server, "/synonyms", {"word": "w1", "num": 3})
+        assert code == 200
+        snap = _get(server, "/metrics")
+        assert snap["index"]["recall_gate_ok"] is False
+        assert snap["index"]["exact_fallbacks"].get("gate", 0) >= 1
+        assert snap["index"]["ann_queries_total"] == 0
+        # The escape hatch stays attributable even while the gate is
+        # failing: an explicit exact=true counts as "requested", never
+        # as "gate".
+        req_before = snap["index"]["exact_fallbacks"].get("requested", 0)
+        gate_before = snap["index"]["exact_fallbacks"]["gate"]
+        code, _ = _post(
+            server, "/synonyms", {"word": "w2", "num": 3, "exact": True}
+        )
+        assert code == 200
+        fb = _get(server, "/metrics")["index"]["exact_fallbacks"]
+        assert fb.get("requested", 0) == req_before + 1
+        assert fb["gate"] == gate_before
+    finally:
+        server.stop()
+        model.stop()

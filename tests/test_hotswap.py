@@ -353,6 +353,126 @@ def test_reload_rejects_geometry_mismatch(publish_dir, tmp_path):
         model.stop()
 
 
+def test_hotswap_with_ann_index_under_load(publish_dir):
+    """ISSUE 12 swap-aware indexing: the hammering-clients drill with
+    the approximate path LIVE. The coarse index flips WITH the tables
+    under the device lock, so the mix sentinel must never surface from
+    an ANN dispatch either; every swap refreshes the index off the
+    request path (refreshes_total grows), the recall gate re-passes
+    per generation, and the compile-free contract holds across swaps
+    on the approximate family too."""
+    pub = publish_dir
+    _flip(pub, "gen-000001")
+    model = load_model(os.path.join(pub, "gen-000001"))
+    server = ModelServer(
+        model, port=0, cache_size=1024, ann=True, ann_recall_sample=8,
+    )
+    assert server._ann_live, "tiny crafted tables must clear the gate"
+    server.watch(pub, poll_seconds=0.05, current="gen-000001")
+    server.start_background()
+    try:
+        results, errors = [], []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    code, out = _post(
+                        server, "/synonyms", {"word": "q", "num": 3}
+                    )
+                except Exception as e:
+                    errors.append(repr(e))
+                    continue
+                top1 = out[0][0] if code == 200 and out else None
+                results.append((code, top1))
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+
+        def wait_responses(n):
+            import time as _t
+            deadline = _t.monotonic() + 60
+            while len(results) < n:
+                assert _t.monotonic() < deadline, "load stalled"
+                _t.sleep(0.01)
+
+        def wait_generation(gen):
+            import time as _t
+            deadline = _t.monotonic() + 60
+            while server.metrics.generation != gen:
+                assert _t.monotonic() < deadline, f"no swap to {gen}"
+                _t.sleep(0.01)
+
+        wait_responses(25)
+        _flip(pub, "gen-000002")
+        wait_generation("gen-000002")
+        wait_responses(len(results) + 25)
+        _flip(pub, "gen-000003")
+        wait_generation("gen-000003")
+        wait_responses(len(results) + 25)
+        code, out = _post(server, "/synonyms", {"word": "q", "num": 3})
+        assert (code, out[0][0]) == (200, "fresh")
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert errors == []
+        assert all(code == 200 for code, _ in results), set(
+            c for c, _ in results
+        )
+        seen = {t for _, t in results}
+        assert seen <= set(EXPECT.values()), seen
+        assert "mix" not in seen
+        assert len(seen) >= 2, seen
+
+        snap = _metrics(server)
+        assert snap["hot_swap"]["table_swaps_total"] == 2
+        assert snap["hot_swap"]["swap_failures_total"] == 0
+        # Boot + one refresh per swap, every generation gate-clean.
+        assert snap["index"]["refreshes_total"] == 3
+        assert snap["index"]["recall_gate_ok"] is True
+        assert snap["index"]["ann_queries_total"] > 0
+        assert snap["index"]["table_versions_behind"] == 0
+        # Zero compiles across swaps on BOTH dispatch families.
+        assert snap["compiles"]["post_warmup"] == 0
+    finally:
+        server.stop()
+        model.stop()
+
+
+def test_corrupt_generation_keeps_old_index_serving(publish_dir):
+    """A generation that fails staging is a counted swap_failure: the
+    previous tables AND the previous index keep serving the
+    approximate path, and no index refresh is recorded."""
+    pub = publish_dir
+    _flip(pub, "gen-000001")
+    model = load_model(os.path.join(pub, "gen-000001"))
+    server = ModelServer(
+        model, port=0, ann=True, ann_recall_sample=8,
+    )
+    server.start_background()
+    try:
+        refreshes = _metrics(server)["index"]["refreshes_total"]
+        code, _ = _post(
+            server, "/reload", {"dir": os.path.join(pub, "gen-999999")}
+        )
+        assert code == 400
+        snap = _metrics(server)
+        assert snap["hot_swap"]["swap_failures_total"] == 1
+        assert snap["index"]["refreshes_total"] == refreshes
+        # Old generation + old index still answering approximately.
+        before = snap["index"]["ann_queries_total"]
+        code, out = _post(server, "/synonyms", {"word": "q", "num": 3})
+        assert (code, out[0][0]) == (200, "a1")
+        assert (
+            _metrics(server)["index"]["ann_queries_total"] == before + 1
+        )
+    finally:
+        server.stop()
+        model.stop()
+
+
 def test_bf16_generation_round_trip(tmp_path):
     """ISSUE 11 dtype round-trip: a bf16-STORAGE trainer publishes a
     generation (fp32 .npy payloads, dtype recorded in engine.json AND
