@@ -388,6 +388,82 @@ def _add_query(sub):
     p.add_argument("--request-deadline", type=float, default=30.0)
     p.add_argument("--degraded-after", type=float, default=5.0)
     _add_ann_flags(p)
+    heal = p.add_argument_group(
+        "self-healing (ISSUE 14)",
+        "replica supervision (waitpid + /healthz probes with a launch-"
+        "generation handshake), probe-driven circuit breaking, and — "
+        "with --watch-checkpoint — rolling generation rollout behind a "
+        "shadow-canary promotion gate",
+    )
+    heal.add_argument("--max-restarts", type=int, default=3,
+                      help="per-replica relaunch budget before the "
+                           "replica is left down (fleet serves from "
+                           "the survivors; default 3)")
+    heal.add_argument("--backoff-base", type=float, default=1.0,
+                      help="first relaunch delay seconds (doubles per "
+                           "restart, capped at --backoff-cap)")
+    heal.add_argument("--backoff-cap", type=float, default=30.0)
+    heal.add_argument("--hang-kill-after", type=float, default=10.0,
+                      help="continuous probe-failure seconds after "
+                           "which a live replica process is killed "
+                           "and relaunched (hung-replica detection)")
+    heal.add_argument("--probe-interval", type=float, default=0.5,
+                      help="seconds between active /healthz probes "
+                           "per replica")
+    heal.add_argument("--probe-timeout", type=float, default=2.0)
+    heal.add_argument("--breaker-failures", type=int, default=3,
+                      help="consecutive probe/connect failures that "
+                           "eject a replica from rotation (breaker "
+                           "opens)")
+    heal.add_argument("--breaker-successes", type=int, default=2,
+                      help="consecutive half-open trial successes "
+                           "that readmit it")
+    heal.add_argument("--breaker-open-seconds", type=float, default=2.0,
+                      help="open-breaker cooldown before half-open "
+                           "trials begin")
+    heal.add_argument("--replica0-env", action="append", default=[],
+                      metavar="KEY=VAL",
+                      help="env var applied to replica 0's FIRST "
+                           "launch only (repeatable) — the chaos-drill "
+                           "seam for arming a GLINT_FAULTS schedule on "
+                           "one replica without re-killing every "
+                           "relaunch")
+    heal.add_argument("--uncoordinated-watch", action="store_true",
+                      help="legacy behavior: every replica follows "
+                           "--watch-checkpoint itself (simultaneous "
+                           "fleet-wide swaps, no rolling rollout, no "
+                           "canary gate)")
+    can = p.add_argument_group(
+        "shadow-canary promotion gate",
+        "before a rolling rollout proceeds, the candidate generation "
+        "serves MIRRORED traffic on one ejected replica and must "
+        "agree with the live fleet (top-k overlap) — regression means "
+        "automatic hold-back, counted on /metrics, with the candidate "
+        "left on disk",
+    )
+    can.add_argument("--no-canary", action="store_true",
+                     help="skip the canary gate (rolling rollout "
+                          "proceeds directly)")
+    can.add_argument("--canary-mirror-every", type=int, default=4,
+                     help="mirror every Nth live /synonyms|/analogy "
+                          "request to the canary (default 4)")
+    can.add_argument("--canary-min-scores", type=int, default=8,
+                     help="responses to score before deciding "
+                          "(default 8)")
+    can.add_argument("--canary-mirror-seconds", type=float, default=10.0,
+                     help="max seconds to collect mirrored responses "
+                          "(default 10)")
+    can.add_argument("--canary-agreement", type=float, default=0.6,
+                     help="mean top-k agreement the candidate must "
+                          "clear to promote (default 0.6)")
+    can.add_argument("--canary-top-k", type=int, default=10)
+    can.add_argument("--canary-probes", default=None, metavar="FILE",
+                     help="JSON list of deterministic probe requests "
+                          '([{"path": "/synonyms", "body": {...}}, '
+                          "...]) posted to both the live fleet and "
+                          "the canary and scored for agreement — the "
+                          "vienna/berlin + capital-of analogy gates, "
+                          "restated as live-vs-candidate checks")
 
     p = sub.add_parser(
         "supervise",
@@ -708,7 +784,7 @@ def _run_fit_stream(args) -> int:
 
 
 def _run_serve_fleet(args) -> int:
-    from glint_word2vec_tpu.fleet import serve_fleet
+    from glint_word2vec_tpu.fleet import CanaryConfig, serve_fleet
 
     if args.model is None and args.watch_checkpoint is None:
         print(
@@ -722,8 +798,11 @@ def _run_serve_fleet(args) -> int:
         "--max-inflight", str(args.max_inflight),
         "--request-deadline", str(args.request_deadline),
         "--degraded-after", str(args.degraded_after),
-        "--watch-poll", str(args.watch_poll),
     ]
+    # (--watch-checkpoint/--watch-poll are NOT appended here: in
+    # coordinated mode the rollout coordinator owns every swap, and in
+    # --uncoordinated-watch mode the FleetSupervisor's replica argv
+    # builder supplies both flags itself.)
     if args.ann:
         flags += [
             "--ann",
@@ -734,15 +813,62 @@ def _run_serve_fleet(args) -> int:
             "--ann-recall-gate", str(args.ann_recall_gate),
             "--ann-recall-sample", str(args.ann_recall_sample),
         ]
+    replica0_env = {}
+    for kv in args.replica0_env:
+        if "=" not in kv:
+            print(
+                f"error: --replica0-env expects KEY=VAL, got {kv!r}",
+                file=sys.stderr,
+            )
+            return 1
+        k, v = kv.split("=", 1)
+        replica0_env[k] = v
+    canary = None
+    if (args.watch_checkpoint is not None and not args.no_canary
+            and not args.uncoordinated_watch):
+        probes = None
+        if args.canary_probes:
+            with open(args.canary_probes) as f:
+                probes = json.load(f)
+            if not isinstance(probes, list):
+                print(
+                    "error: --canary-probes must be a JSON list of "
+                    '{"path", "body"} objects',
+                    file=sys.stderr,
+                )
+                return 1
+        canary = CanaryConfig(
+            mirror_every=args.canary_mirror_every,
+            min_scores=args.canary_min_scores,
+            mirror_seconds=args.canary_mirror_seconds,
+            agreement_gate=args.canary_agreement,
+            top_k=args.canary_top_k,
+            probes=probes,
+        )
     return serve_fleet(
         args.model,
         replicas=args.replicas,
         host=args.host,
         port=args.port,
         watch_dir=args.watch_checkpoint,
+        watch_poll=args.watch_poll,
         replica_flags=flags,
         log_dir=args.replica_log_dir,
         port_file=args.port_file,
+        max_restarts=args.max_restarts,
+        backoff_base_seconds=args.backoff_base,
+        backoff_cap_seconds=args.backoff_cap,
+        hang_kill_seconds=args.hang_kill_after,
+        probe_interval=args.probe_interval,
+        probe_timeout=args.probe_timeout,
+        breaker_failures=args.breaker_failures,
+        breaker_successes=args.breaker_successes,
+        breaker_open_seconds=args.breaker_open_seconds,
+        canary=canary,
+        coordinated=not args.uncoordinated_watch,
+        replica_env_first_launch=(
+            {0: replica0_env} if replica0_env else None
+        ),
     )
 
 

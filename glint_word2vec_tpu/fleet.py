@@ -1,33 +1,50 @@
-"""Horizontal serving: N replica processes behind one load balancer.
+"""Self-healing horizontal serving: N supervised replicas behind one
+breaker-aware load balancer, with rolling generation rollout and a
+shadow-canary promotion gate.
 
-One serving process tops out on one device and one GIL; production
-traffic needs N of them. This module adds the front half of ISSUE 12's
-scale-out story:
+PR 12 put N replica processes behind one round-robin proxy; this module
+adds the robustness half (ISSUE 14) — the serving tier's PR 7:
 
-* :class:`LoadBalancer` — a stdlib HTTP proxy that spreads requests
-  round-robin over a replica fleet, using the replicas' OWN overload
-  signals (PR 7's bounded-admission 429 and degraded-mode 429/503) as
-  honest backpressure: a shed replica is skipped for the next one, and
-  only when EVERY replica sheds does the client see the 429 (with its
-  ``Retry-After``) — the balancer never invents capacity, it only finds
-  it. Per-replica connections are kept alive per handler thread, so the
-  proxy adds one local hop, not a reconnect.
+* :class:`LoadBalancer` — the stdlib raw-socket proxy, now with a
+  per-replica :class:`ReplicaBreaker` (closed / open / half-open)
+  driven by an active health prober AND the data plane's own
+  connection verdicts: K consecutive failures eject a replica from
+  rotation (so a bouncing replica costs zero client latency instead of
+  a timeout per round-robin turn), a cooldown half-opens it for prober
+  trials, and M consecutive successes readmit it. Overload sheds
+  (429/503) still retry onto the next replica and relay honest
+  backpressure on exhaustion.
 
-* Fleet observability — ``GET /metrics`` scrapes every replica's JSON
-  snapshot and folds them through PR 8's
-  :func:`~glint_word2vec_tpu.obs.aggregate.merge_serving_snapshots`
-  into ONE ServingMetrics-shaped document (rendered by the same
-  ``serving_to_prometheus``, index family included), alongside
-  per-replica blocks and the balancer's own counters
-  (``fleet_to_prometheus``).
+* :class:`FleetSupervisor` — the PR 7 supervisor machinery on the
+  serving tier: launches the replica subprocesses, watches liveness
+  two ways (``waitpid`` for crashes; sustained probe failure for
+  hangs, with the ``GLINT_FLEET_GEN`` generation handshake so a stale
+  pre-restart process can never answer for the new one), and
+  relaunches dead or hung replicas from the fleet's current model
+  directory under capped exponential backoff and a per-replica restart
+  budget. A replica out of budget is left down and counted; the fleet
+  serves from the survivors.
 
-* :func:`serve_fleet` — the launcher: N ``cli serve`` subprocesses on
-  ephemeral ports following one model dir (or one publish dir, so a
-  streaming trainer hot-swaps the WHOLE fleet), readiness via each
-  replica's ``--port-file`` (written only after warmup, so the
-  balancer never routes to a cold replica), then the balancer in the
-  launcher process. ``POST /shutdown`` on the balancer fans out to
-  every replica and stops the fleet — the one-switch teardown CI uses.
+* :class:`RolloutCoordinator` — when ``LATEST.json`` moves, replicas
+  are swapped ONE AT A TIME: drain via breaker hold, ``POST /reload``,
+  wait healthy + warm (the swap added zero post-warmup compiles),
+  readmit, next — fleet capacity never drops below N-1, and a
+  generation that fails to stage halts the rollout with the old
+  generation still serving everywhere else.
+
+* Shadow-canary promotion gate (ROADMAP item 5's loop, closed): before
+  the rollout proceeds, the candidate generation is staged on ONE held
+  replica which never sees live traffic; a sampled slice of live
+  queries is mirrored to it and scored for top-k agreement against the
+  live fleet, alongside operator-defined probe queries
+  (vienna/berlin-style synonym + capital-of analogy checks,
+  QUALITY.json-style). Regression means automatic hold-back: the
+  canary is restored to the live generation, the candidate is counted,
+  exposed on ``/metrics``, and left on disk for postmortem.
+
+Fault points ``fleet.replica_probe`` / ``fleet.rollout_step`` (and
+``serving.reload`` on the replica side) drill every window;
+``scripts/fleet_drill.py`` records FLEET_BENCH.json.
 
 Replicas are plain ``serve`` processes: nothing here is in their code
 path, so a balancer crash leaves N independently addressable servers.
@@ -39,14 +56,22 @@ import http.client
 import json
 import logging
 import os
-import signal
+import random
 import socket
 import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+from glint_word2vec_tpu.parallel.supervisor import (
+    capped_backoff,
+    terminate_process,
+)
+from glint_word2vec_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +120,180 @@ def _read_request(sock, buf: bytearray):
 _SHED_STATUSES = frozenset((429, 503))
 
 
+class ReplicaBreaker:
+    """Per-replica circuit breaker: closed / open / half-open.
+
+    Fed by BOTH failure signals — the active health prober's verdicts
+    and the data plane's own connection errors. ``fail_threshold``
+    consecutive failures open the breaker (the replica is ejected from
+    rotation, so clients stop paying its timeouts); after
+    ``open_seconds`` the prober half-opens it with trial probes, and
+    ``success_threshold`` consecutive successes re-close it. A
+    half-open trial failure re-opens immediately.
+
+    Separately from the state machine, an **administrative hold**
+    (:meth:`hold` / :meth:`release`) takes the replica out of client
+    rotation regardless of health — the rollout coordinator's drain
+    seam, and what keeps a canary staging a CANDIDATE generation from
+    ever serving live traffic.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, *, fail_threshold: int = 3,
+                 success_threshold: int = 2,
+                 open_seconds: float = 2.0):
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.success_threshold = max(1, int(success_threshold))
+        self.open_seconds = float(open_seconds)
+        self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0          # consecutive (closed-state) failures
+        self._trial_successes = 0   # consecutive half-open successes
+        self._opened_at: Optional[float] = None
+        self._failing_since: Optional[float] = None
+        self._held = 0
+        self._opened_total = 0
+        self._reopened_total = 0
+        self._closed_total = 0
+        self._probe_failures = 0
+        self._probe_successes = 0
+
+    def record_failure(self, probe: bool = False) -> None:
+        """One failed probe or data-plane connection attempt."""
+        with self._mu:
+            if probe:
+                self._probe_failures += 1
+            if self._failing_since is None:
+                self._failing_since = time.monotonic()
+            if self._state == self.HALF_OPEN:
+                # A failed trial re-opens immediately: the replica is
+                # still bouncing, restart its cooldown.
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+                self._trial_successes = 0
+                self._reopened_total += 1
+            elif self._state == self.CLOSED:
+                self._failures += 1
+                if self._failures >= self.fail_threshold:
+                    self._state = self.OPEN
+                    self._opened_at = time.monotonic()
+                    self._opened_total += 1
+
+    def record_success(self, probe: bool = False) -> None:
+        """One healthy probe answer or successful proxied exchange."""
+        with self._mu:
+            if probe:
+                self._probe_successes += 1
+            self._failing_since = None
+            if self._state == self.HALF_OPEN:
+                self._trial_successes += 1
+                if self._trial_successes >= self.success_threshold:
+                    self._state = self.CLOSED
+                    self._failures = 0
+                    self._trial_successes = 0
+                    self._opened_at = None
+                    self._closed_total += 1
+            elif self._state == self.CLOSED:
+                self._failures = 0
+
+    def maybe_half_open(self) -> bool:
+        """Prober seam: move open -> half-open once the cooldown
+        elapsed. Returns True when the replica should receive a trial
+        probe (it is half-open), False while still cooling (no traffic,
+        no probes) or not open at all."""
+        with self._mu:
+            if (self._state == self.OPEN and self._opened_at is not None
+                    and time.monotonic() - self._opened_at
+                    >= self.open_seconds):
+                self._state = self.HALF_OPEN
+                self._trial_successes = 0
+            return self._state == self.HALF_OPEN
+
+    def force_open(self) -> None:
+        """Supervisor seam: the replica process is KNOWN dead or
+        restarting — eject immediately and keep refreshing the cooldown
+        so no trial traffic flows until the supervisor readmits it."""
+        with self._mu:
+            if self._state == self.CLOSED:
+                self._opened_total += 1
+            self._state = self.OPEN
+            self._opened_at = time.monotonic()
+            self._trial_successes = 0
+
+    def trial(self) -> None:
+        """Supervisor seam: a relaunched replica adopted a fresh
+        address — go straight to half-open so it earns readmission
+        through ``success_threshold`` probe successes (the PR 7
+        don't-trust-a-fresh-worker pattern)."""
+        with self._mu:
+            self._state = self.HALF_OPEN
+            self._trial_successes = 0
+            self._failures = 0
+            self._failing_since = None
+
+    def hold(self) -> None:
+        """Administrative ejection (rollout drain / canary staging)."""
+        with self._mu:
+            self._held += 1
+
+    def release(self) -> None:
+        with self._mu:
+            self._held = max(0, self._held - 1)
+
+    def clear_holds(self) -> None:
+        """Supervisor seam, called when a RELAUNCHED replica's fresh
+        address is adopted: any hold belonged to its previous
+        incarnation (a rollout drain or canary staging that died under
+        it) and the new process boots from the fleet's promoted
+        generation — leaving the hold would park the replica serving
+        nothing forever."""
+        with self._mu:
+            self._held = 0
+
+    def held(self) -> bool:
+        with self._mu:
+            return self._held > 0
+
+    def eligible(self) -> bool:
+        """Whether client traffic may route here: closed and not
+        administratively held."""
+        with self._mu:
+            return self._state == self.CLOSED and self._held == 0
+
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def failing_for(self) -> float:
+        """Seconds of CONTINUOUS failure (0.0 while healthy) — the
+        fleet supervisor's hung-replica signal."""
+        with self._mu:
+            fs = self._failing_since
+            return 0.0 if fs is None else time.monotonic() - fs
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            fs = self._failing_since
+            return {
+                "state": self._state,
+                "held": self._held > 0,
+                "consecutive_failures": self._failures,
+                "trial_successes": self._trial_successes,
+                "opened_total": self._opened_total,
+                "reopened_total": self._reopened_total,
+                "closed_total": self._closed_total,
+                "probe_failures_total": self._probe_failures,
+                "probe_successes_total": self._probe_successes,
+                "failing_seconds": (
+                    round(time.monotonic() - fs, 2)
+                    if fs is not None else 0.0
+                ),
+            }
+
+
 class _ReplicaConn:
     """One persistent keep-alive socket to a replica with a minimal
     HTTP/1.1 reader — the balancer's per-request cost IS the fleet's
@@ -103,12 +302,20 @@ class _ReplicaConn:
     locking. The replica always answers Content-Length-framed JSON
     (serving.py's ``_send``)."""
 
-    __slots__ = ("host", "port", "timeout", "_sock", "_buf", "_prefix")
+    __slots__ = ("host", "port", "timeout", "addr_version", "_sock",
+                 "_buf", "_sent", "_prefix")
 
-    def __init__(self, host: str, port: int, timeout: float):
+    def __init__(self, host: str, port: int, timeout: float,
+                 addr_version: int = 0):
         self.host, self.port, self.timeout = host, port, timeout
+        #: Balancer address-table version this connection was built
+        #: against: a supervisor relaunch bumps it, and the pool drops
+        #: conns whose version is stale (a relaunched replica lives on
+        #: a fresh ephemeral port).
+        self.addr_version = addr_version
         self._sock = None
         self._buf = bytearray()
+        self._sent = False
         self._prefix = (
             f"Host: {host}:{port}\r\n"
             "Content-Type: application/json\r\n"
@@ -127,24 +334,41 @@ class _ReplicaConn:
         self._buf.clear()
         return s
 
-    def roundtrip(self, method: str, path: str, body: bytes):
+    def roundtrip(self, method: str, path: str, body: bytes,
+                  retryable: Optional[bool] = None):
         """One request/response exchange; returns (status, body,
         header-dict with lowercase keys). Raises on any transport
         error (caller drops the connection and tries the next
-        replica)."""
-        sock = self._sock or self._connect()
+        replica).
+
+        A stale keep-alive socket after a replica bounce fails in one
+        of two places: the send (nothing reached a handler — always
+        safe to retry on a fresh connection) or the receive AFTER a
+        locally-"successful" send into a dead socket's buffer. The
+        recv-side retry is taken exactly once and only for idempotent
+        requests (GETs by default; override with ``retryable``) — a
+        bounced replica then costs the client nothing instead of a
+        surfaced transport error."""
+        if retryable is None:
+            retryable = method == "GET"
         req = (
             f"{method} {path} HTTP/1.1\r\n{self._prefix}"
             f"{len(body)}\r\n\r\n"
         ).encode("latin-1") + body
         try:
-            sock.sendall(req)
+            return self._exchange(req)
         except OSError:
-            # The replica closed our idle keep-alive socket (timeout,
-            # restart): one fresh-connection retry is safe — nothing
-            # of this request reached a handler.
-            sock = self._connect()
-            sock.sendall(req)
+            if self._sent and not retryable:
+                raise
+            self.close()
+            self._connect()
+            return self._exchange(req)
+
+    def _exchange(self, req: bytes):
+        sock = self._sock or self._connect()
+        self._sent = False
+        sock.sendall(req)
+        self._sent = True
         buf = self._buf
         while True:
             head_end = buf.find(b"\r\n\r\n")
@@ -183,33 +407,82 @@ class _ReplicaConn:
 
 
 class LoadBalancer:
-    """Round-robin HTTP proxy over serving replicas with
-    overload-aware retry and a merged fleet exposition.
+    """Round-robin HTTP proxy over serving replicas with per-replica
+    circuit breakers, overload-aware retry, and a merged fleet
+    exposition.
 
     Routes:
       GET  /healthz   fleet health: replicas up/total (200 while >= 1 up)
       GET  /metrics   merged fleet snapshot (JSON; ?format=prometheus
                       renders the merged serving exposition + the
-                      glint_fleet_* balancer family)
+                      glint_fleet_* balancer/breaker/rollout families)
       POST /shutdown  fan-out shutdown to every replica, then stop
-      anything else   proxied to a replica (round robin; sheds retried
-                      on the next replica, exhaustion relays the shed)
+      anything else   proxied to a replica (round robin over CLOSED
+                      breakers; sheds retried on the next replica,
+                      exhaustion relays the shed; open breakers are a
+                      last resort, held replicas never serve)
     """
+
+    #: Same-replica retries for a connection-refused inside a KNOWN
+    #: restart window (the supervisor may land the relaunched
+    #: replica's fresh address mid-retry).
+    RESTART_RETRIES = 3
+    RESTART_RETRY_BASE = 0.1
+
+    #: ``replicas`` entries are replaced wholesale (one atomic tuple
+    #: store) by ``set_replica_address`` under the lock; the hot-path
+    #: readers take a single indexed load of an immutable tuple, where
+    #: a stale read only means one more attempt against the old
+    #: address — the retry/breaker machinery absorbs it. ``doc_extra``
+    #: and ``on_shutdown`` are installed once by the fleet supervisor
+    #: before the data plane starts.
+    _ATOMIC_ATTRS = frozenset({"replicas", "doc_extra", "on_shutdown"})
 
     def __init__(self, replica_urls: List[str], host: str = "127.0.0.1",
                  port: int = 0, *, scrape_timeout: float = 2.0,
-                 proxy_timeout: float = 60.0):
+                 proxy_timeout: float = 60.0,
+                 breaker_failures: int = 3,
+                 breaker_successes: int = 2,
+                 breaker_open_seconds: float = 2.0,
+                 probe_interval: float = 0.5,
+                 probe_timeout: float = 2.0):
         self.replicas = [self._parse(u) for u in replica_urls]
         if not self.replicas:
             raise ValueError("at least one replica url required")
         self.scrape_timeout = float(scrape_timeout)
         self.proxy_timeout = float(proxy_timeout)
+        self.probe_interval = max(0.02, float(probe_interval))
+        self.probe_timeout = float(probe_timeout)
         self._mu = threading.Lock()
         self._rr = 0
         self._proxied = [0] * len(self.replicas)
         self._errors = [0] * len(self.replicas)
         self._shed_retries = 0
         self._exhausted = 0
+        self._breaker_skips = 0
+        self._restart_retries = 0
+        self._addr_version = [0] * len(self.replicas)
+        self._expected_gen: List[Optional[str]] = [None] * len(self.replicas)
+        self._restarting = [False] * len(self.replicas)
+        #: Shadow-mirror state (canary evaluations): None when off;
+        #: else {"paths", "every", "seen", "queue", "dropped"} guarded
+        #: by ``_mu`` — the coordinator drains the bounded queue.
+        self._mirror: Optional[dict] = None
+        self.breakers = [
+            ReplicaBreaker(
+                fail_threshold=breaker_failures,
+                success_threshold=breaker_successes,
+                open_seconds=breaker_open_seconds,
+            )
+            for _ in self.replicas
+        ]
+        #: Extra top-level blocks merged into ``metrics_doc`` (the
+        #: fleet supervisor's restart/rollout/canary accounting).
+        self.doc_extra: Optional[Callable[[], dict]] = None
+        #: Invoked at the START of a POST /shutdown, before replicas
+        #: are told to exit — the supervisor's don't-restart-the-dead
+        #: flag must be up before the first replica goes down.
+        self.on_shutdown: Optional[Callable[[], None]] = None
         self._local = threading.local()
         # Data plane: a thread-per-connection raw-socket loop with a
         # minimal HTTP/1.1 parser instead of ThreadingHTTPServer. The
@@ -225,6 +498,7 @@ class LoadBalancer:
         self.host, self.port = self._listener.getsockname()[:2]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._prober: Optional[threading.Thread] = None
         self._prev_switch: Optional[float] = None
 
     # -- data plane ----------------------------------------------------
@@ -329,6 +603,8 @@ class LoadBalancer:
                 )
             return self._respond_json(sock, 200, doc)
         if method == "POST" and url.path == "/shutdown":
+            if self.on_shutdown is not None:
+                self.on_shutdown()
             results = self.shutdown_fleet()
             self._respond_json(sock, 200, {
                 "status": "shutting down fleet",
@@ -348,16 +624,52 @@ class LoadBalancer:
         u = urlparse(url if "//" in url else f"http://{url}")
         return (u.hostname, int(u.port))
 
+    # -- replica address table (supervisor seam) -----------------------
+
+    def set_replica_address(self, i: int, host: str, port: int,
+                            generation: Optional[str] = None) -> None:
+        """Point replica slot ``i`` at a (re)launched process. Bumps
+        the address version so every handler thread's cached
+        keep-alive connection to the old incarnation is dropped on its
+        next use; ``generation`` arms the /healthz handshake the
+        prober verifies."""
+        with self._mu:
+            self.replicas[i] = (host, int(port))
+            self._addr_version[i] += 1
+            self._expected_gen[i] = generation
+
+    def set_restarting(self, i: int, flag: bool) -> None:
+        """Mark a replica as inside a known restart window: a
+        connection-refused there is retried with jittered backoff
+        (the address may land mid-retry) instead of counting as a
+        dead-replica degrade."""
+        with self._mu:
+            self._restarting[i] = flag
+
+    def is_restarting(self, i: int) -> bool:
+        with self._mu:
+            return self._restarting[i]
+
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
     # -- request forwarding --------------------------------------------
 
     def _conn(self, i: int) -> "_ReplicaConn":
+        with self._mu:
+            host, port = self.replicas[i]
+            ver = self._addr_version[i]
         pool = getattr(self._local, "conns", None)
         if pool is None:
             pool = self._local.conns = {}
         c = pool.get(i)
+        if c is not None and c.addr_version != ver:
+            c.close()
+            c = None
         if c is None:
-            host, port = self.replicas[i]
-            c = pool[i] = _ReplicaConn(host, port, self.proxy_timeout)
+            c = pool[i] = _ReplicaConn(
+                host, port, self.proxy_timeout, addr_version=ver
+            )
         return c
 
     def _drop_conn(self, i: int) -> None:
@@ -373,44 +685,82 @@ class LoadBalancer:
             self._rr += 1
             return self._rr
 
-    def forward(self, method: str, path: str, body: bytes):
-        """Send one request to the fleet: round-robin start, advance on
-        connection failure or a shed status (429/503), at most one
-        attempt per replica. Returns (status, body, headers). When
-        every replica sheds, the LAST shed response is relayed — its
-        Retry-After included — so the client sees the fleet's own
-        backpressure, not an invented error.
-
-        The hop rides one persistent raw keep-alive socket per
-        (handler thread, replica) with a minimal response reader: at
-        fleet throughput the balancer's per-request CPU is the fleet's
-        overhead floor, so the hot path avoids the ``http.client``
-        object machinery entirely."""
-        n = len(self.replicas)
-        start = self._next_start()
-        last_shed = None
-        attempted = 0
-        for j in range(n):
-            i = (start + j) % n
+    def _attempt(self, i: int, method: str, path: str, body: bytes):
+        """One replica attempt; (status, body, headers) or None on
+        connection failure (breaker and error accounting applied). A
+        connection-refused inside a known restart window retries the
+        SAME slot with jittered backoff — the supervisor may land the
+        relaunched replica's fresh address mid-retry, and a bounce
+        must not read as a dead-replica degrade."""
+        for attempt in range(self.RESTART_RETRIES + 1):
             try:
-                status, rbody, rheaders = self._conn(i).roundtrip(
-                    method, path, body
+                return self._conn(i).roundtrip(method, path, body)
+            except ConnectionRefusedError:
+                self._drop_conn(i)
+                if (not self.is_restarting(i)
+                        or attempt >= self.RESTART_RETRIES):
+                    break
+                with self._mu:
+                    self._restart_retries += 1
+                time.sleep(
+                    self.RESTART_RETRY_BASE * (attempt + 1)
+                    * (0.5 + random.random())
                 )
             except Exception:
                 self._drop_conn(i)
-                with self._mu:
-                    self._errors[i] += 1
-                attempted += 1
-                continue
+                break
+        with self._mu:
+            self._errors[i] += 1
+        self.breakers[i].record_failure()
+        return None
+
+    def forward(self, method: str, path: str, body: bytes):
+        """Send one request to the fleet: round-robin start over
+        CLOSED breakers, advance on connection failure or a shed
+        status (429/503), at most one attempt per replica. Returns
+        (status, body, headers). When every replica sheds, the LAST
+        shed response is relayed — its Retry-After included — so the
+        client sees the fleet's own backpressure, not an invented
+        error.
+
+        Open/half-open breakers are skipped (each skip is a timeout a
+        client did not pay) and only attempted as a last resort when
+        no closed replica answered. Administratively HELD replicas are
+        never attempted: a hold means a rollout drain or a canary
+        serving a CANDIDATE generation that must not touch live
+        traffic."""
+        n = len(self.replicas)
+        start = self._next_start()
+        order = [(start + j) % n for j in range(n)]
+        eligible = [i for i in order if self.breakers[i].eligible()]
+        fallback = [
+            i for i in order
+            if not self.breakers[i].eligible()
+            and not self.breakers[i].held()
+        ]
+        if len(eligible) < n:
+            with self._mu:
+                self._breaker_skips += n - len(eligible)
+        last_shed = None
+        attempted = 0
+        for i in eligible + fallback:
+            got = self._attempt(i, method, path, body)
             attempted += 1
+            if got is None:
+                continue
+            status, rbody, rheaders = got
+            # ANY HTTP answer proves the process is alive — a shed is
+            # backpressure, not breakage.
+            self.breakers[i].record_success()
             if status in _SHED_STATUSES:
-                last_shed = (status, rbody, rheaders)
+                last_shed = got
                 with self._mu:
                     self._shed_retries += 1
                 continue
             with self._mu:
                 self._proxied[i] += 1
-            return status, rbody, rheaders
+            self._maybe_mirror(method, path, body, status, rbody)
+            return got
         with self._mu:
             self._exhausted += 1
         if last_shed is not None:
@@ -423,12 +773,117 @@ class LoadBalancer:
             {"Content-Type": "application/json", "Retry-After": "1"},
         )
 
+    # -- shadow mirroring (canary evaluations) -------------------------
+
+    def start_mirror(self, paths, every: int,
+                     max_queue: int = 256) -> None:
+        """Begin sampling live POST traffic on ``paths``: every
+        ``every``-th successful response is queued as (path, body,
+        status, response-body) for the canary scorer to drain. The
+        queue is bounded; overflow is dropped and counted — mirroring
+        must never apply backpressure to live clients."""
+        with self._mu:
+            self._mirror = {
+                "paths": frozenset(paths),
+                "every": max(1, int(every)),
+                "seen": 0,
+                "queue": deque(),
+                "max_queue": max(1, int(max_queue)),
+                "dropped": 0,
+            }
+
+    def drain_mirror(self, limit: int = 16) -> List[tuple]:
+        with self._mu:
+            m = self._mirror
+            if m is None:
+                return []
+            out = []
+            while m["queue"] and len(out) < limit:
+                out.append(m["queue"].popleft())
+            return out
+
+    def stop_mirror(self) -> None:
+        with self._mu:
+            self._mirror = None
+
+    def _maybe_mirror(self, method: str, path: str, body: bytes,
+                      status: int, rbody: bytes) -> None:
+        if method != "POST":
+            return
+        with self._mu:
+            m = self._mirror
+            if m is None or urlparse(path).path not in m["paths"]:
+                return
+            m["seen"] += 1
+            if m["seen"] % m["every"]:
+                return
+            if len(m["queue"]) >= m["max_queue"]:
+                m["dropped"] += 1
+                return
+            m["queue"].append((path, body, status, rbody))
+
+    # -- active health probing -----------------------------------------
+
+    def start_prober(self) -> None:
+        """Start the active health prober: every ``probe_interval``
+        each replica's ``/healthz`` is probed (2s default timeout) and
+        the verdict feeds its breaker — K consecutive failures eject,
+        a cooldown half-opens, M trial successes readmit. Replicas
+        inside an open breaker's cooldown get NO probes (and no
+        traffic)."""
+        if self._prober is not None:
+            return
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="glint-fleet-prober",
+        )
+        self._prober.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            for i in range(len(self.replicas)):
+                b = self.breakers[i]
+                if b.state() == ReplicaBreaker.OPEN \
+                        and not b.maybe_half_open():
+                    continue  # cooling down: no probes either
+                self.probe_replica(i)
+
+    def probe_replica(self, i: int) -> bool:
+        """One active /healthz probe of replica ``i``; feeds the
+        breaker and returns the verdict. A probe is healthy only when
+        the replica answers 200 AND — when the supervisor armed a
+        launch generation — echoes the expected ``fleet_generation``
+        (the PR 7 handshake: a stale pre-restart process must never
+        answer for the new one)."""
+        b = self.breakers[i]
+        ok = False
+        try:
+            faults.fire("fleet.replica_probe")
+            status, h = self._get_json(
+                i, "/healthz", timeout=self.probe_timeout
+            )
+            with self._mu:
+                expected = self._expected_gen[i]
+            ok = status == 200
+            if ok and expected is not None:
+                ok = str(h.get("fleet_generation")) == str(expected)
+        except Exception:
+            ok = False
+        if ok:
+            b.record_success(probe=True)
+        else:
+            b.record_failure(probe=True)
+        return ok
+
     # -- fleet views ---------------------------------------------------
 
-    def _get_json(self, i: int, path: str):
-        host, port = self.replicas[i]
+    def _get_json(self, i: int, path: str,
+                  timeout: Optional[float] = None):
+        with self._mu:
+            host, port = self.replicas[i]
         conn = http.client.HTTPConnection(
-            host, port, timeout=self.scrape_timeout
+            host, port,
+            timeout=self.scrape_timeout if timeout is None else timeout,
         )
         try:
             conn.request("GET", path)
@@ -452,11 +907,13 @@ class LoadBalancer:
                 state = "unreachable"
             states.append({
                 "url": self.replica_url(i), "state": state,
+                "breaker": self.breakers[i].state(),
             })
         return up, len(self.replicas), states
 
     def replica_url(self, i: int) -> str:
-        host, port = self.replicas[i]
+        with self._mu:
+            host, port = self.replicas[i]
         return f"http://{host}:{port}"
 
     def balancer_stats(self) -> dict:
@@ -466,12 +923,16 @@ class LoadBalancer:
                 "exhausted_total": self._exhausted,
                 "proxied_total": int(sum(self._proxied)),
                 "proxy_errors_total": int(sum(self._errors)),
+                "breaker_skips_total": self._breaker_skips,
+                "restart_retries_total": self._restart_retries,
             }
 
     def metrics_doc(self) -> dict:
         """The merged fleet document: per-replica snapshots (scraped
-        now, failures reported not fatal), the PR 8 exact merge as
-        ``fleet``, and the balancer's own counters."""
+        now, failures reported not fatal) with breaker state, the PR 8
+        exact merge as ``fleet``, the balancer's own counters, and —
+        when a fleet supervisor is attached — its restart/rollout/
+        canary blocks."""
         from glint_word2vec_tpu.obs.aggregate import (
             merge_serving_snapshots,
         )
@@ -481,11 +942,14 @@ class LoadBalancer:
         with self._mu:
             proxied = list(self._proxied)
             errors = list(self._errors)
+            restarting = list(self._restarting)
         for i in range(len(self.replicas)):
             entry: Dict[str, object] = {
                 "url": self.replica_url(i),
                 "proxied_total": proxied[i],
                 "proxy_errors_total": errors[i],
+                "breaker": self.breakers[i].snapshot(),
+                "restarting": restarting[i],
             }
             try:
                 _, snap = self._get_json(i, "/metrics")
@@ -496,17 +960,22 @@ class LoadBalancer:
                 entry["up"] = False
                 entry["scrape_error"] = str(e)
             replicas.append(entry)
-        return {
+        doc = {
             "replicas": replicas,
             "fleet": merge_serving_snapshots(snaps),
             "balancer": self.balancer_stats(),
         }
+        extra = self.doc_extra() if self.doc_extra is not None else None
+        if extra:
+            doc.update(extra)
+        return doc
 
     def shutdown_fleet(self) -> List[dict]:
         """POST /shutdown to every replica (best effort)."""
         results = []
         for i in range(len(self.replicas)):
-            host, port = self.replicas[i]
+            with self._mu:
+                host, port = self.replicas[i]
             try:
                 conn = http.client.HTTPConnection(
                     host, port, timeout=self.scrape_timeout
@@ -584,21 +1053,1177 @@ class LoadBalancer:
 
 
 # ----------------------------------------------------------------------
-# Launcher
+# Rolling rollout + shadow-canary promotion gate
 # ----------------------------------------------------------------------
 
 
-def _replica_argv(i: int, port_file: str, model_dir: Optional[str],
-                  watch_dir: Optional[str], replica_flags: List[str]):
-    argv = [
-        sys.executable, "-m", "glint_word2vec_tpu.cli", "serve",
-        "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
-    ]
-    if model_dir:
-        argv += ["--model", model_dir]
-    if watch_dir:
-        argv += ["--watch-checkpoint", watch_dir]
-    return argv + list(replica_flags)
+def _topk_overlap(a, b, k: int) -> Optional[float]:
+    """Agreement score between two /synonyms-or-/analogy JSON answers:
+    |intersection| / max(|a|, |b|) over the top-k words. None when
+    either side is not a scoreable hit list."""
+    try:
+        wa = [x[0] for x in a][: max(1, int(k))]
+        wb = [x[0] for x in b][: max(1, int(k))]
+    except (TypeError, IndexError):
+        return None
+    if not wa and not wb:
+        return None
+    sa, sb = set(wa), set(wb)
+    return len(sa & sb) / max(len(sa), len(sb), 1)
+
+
+class CanaryConfig:
+    """Knobs for the shadow-canary promotion gate.
+
+    ``probes`` are operator-defined deterministic checks — each a
+    ``{"path": "/synonyms"|"/analogy", "body": {...}}`` request posted
+    to BOTH the live fleet and the canary and scored for top-k
+    agreement (the vienna/berlin + capital-of analogy gates of
+    QUALITY.json, restated as live-vs-candidate agreement so no
+    expected-answer labels are needed). Mirrored live traffic — every
+    ``mirror_every``-th request on ``mirror_paths`` — adds organic
+    samples until ``min_scores`` are collected or ``mirror_seconds``
+    elapse. The mean agreement must clear ``agreement_gate`` or the
+    candidate is held back. Choose probe words stable across
+    generations: a live-404/canary-404 pair is unscorable (skipped),
+    a one-sided 404 scores 0.
+    """
+
+    def __init__(self, *, mirror_paths=("/synonyms", "/analogy"),
+                 mirror_every: int = 4, min_scores: int = 8,
+                 mirror_seconds: float = 10.0,
+                 agreement_gate: float = 0.6, top_k: int = 10,
+                 probes: Optional[List[dict]] = None):
+        self.mirror_paths = tuple(mirror_paths)
+        self.mirror_every = max(1, int(mirror_every))
+        self.min_scores = max(0, int(min_scores))
+        self.mirror_seconds = float(mirror_seconds)
+        self.agreement_gate = float(agreement_gate)
+        self.top_k = max(1, int(top_k))
+        self.probes = list(probes or [])
+
+
+class RolloutCoordinator:
+    """Orders fleet-wide generation rollouts, one replica at a time.
+
+    Follows ``LATEST.json`` the way the serving ``SnapshotWatcher``
+    does, but instead of letting every replica swap simultaneously it
+    drives the sequence: (canary gate, when configured) then for each
+    replica — breaker hold, drain, ``POST /reload`` with the explicit
+    generation dir, wait healthy + warm (the swap added zero
+    post-warmup compiles), readmit. Fleet capacity never drops below
+    N-1 replicas.
+
+    Failure taxonomy:
+      * replica unavailable (dead / mid-restart / not yet readmitted):
+        the rollout HALTS — the old generation keeps serving on every
+        un-swapped replica — and is retried on a later poll once the
+        fleet is whole again;
+      * staging failure (replica answered /reload with an error): the
+        generation is marked failed and NOT retried until the pointer
+        moves (the SnapshotWatcher contract, fleet-wide);
+      * canary regression: the candidate is held back — canary
+        restored to the live generation, counted, left on disk.
+    """
+
+    def __init__(self, lb: LoadBalancer, watch_dir: str, *,
+                 poll_seconds: float = 1.0,
+                 current: Optional[str] = None,
+                 current_dir: Optional[str] = None,
+                 canary: Optional[CanaryConfig] = None,
+                 step_timeout: float = 600.0,
+                 drain_seconds: float = 0.25,
+                 replica_ok: Optional[Callable[[int], bool]] = None,
+                 on_generation=None):
+        self.lb = lb
+        self.watch_dir = watch_dir
+        self.poll_seconds = max(0.05, float(poll_seconds))
+        self.canary = canary
+        self.step_timeout = float(step_timeout)
+        self.drain_seconds = float(drain_seconds)
+        self._replica_ok = replica_ok or (lambda i: True)
+        self.on_generation = on_generation
+        self._mu = threading.Lock()
+        #: Generation name the whole fleet serves (None when booted
+        #: from a plain --model dir outside the publish protocol).
+        self.current = current
+        #: Model directory replicas (re)launch from — the previous
+        #: generation the canary is restored to on hold-back.
+        self.current_dir = current_dir
+        self._failed: Optional[str] = None
+        self._held_back: Optional[str] = None
+        self._in_progress = False
+        self._phase = "idle"
+        self._stats = {
+            "rollouts_started_total": 0,
+            "rollouts_completed_total": 0,
+            "rollouts_halted_total": 0,
+            "rollout_steps_total": 0,
+            "generations_failed_total": 0,
+            "watch_errors_total": 0,
+            "canary": {
+                "evaluations_total": 0,
+                "holdbacks_total": 0,
+                "last_agreement": None,
+                "last_scored": 0,
+                "last_generation": None,
+                "last_verdict": None,
+                "agreement_gate": (
+                    canary.agreement_gate if canary is not None else None
+                ),
+            },
+        }
+        self._poll_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- pointer following ---------------------------------------------
+
+    def poll_once(self) -> Optional[str]:
+        """One pointer check; returns the generation name when a full
+        rollout completed, else None. Never raises."""
+        with self._poll_mu:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> Optional[str]:
+        from glint_word2vec_tpu.streaming.publish import read_latest
+
+        try:
+            latest = read_latest(self.watch_dir, raise_errors=True)
+        except (OSError, ValueError) as e:
+            with self._mu:
+                self._stats["watch_errors_total"] += 1
+            logger.warning(
+                "rollout coordinator: transient pointer read error: %s "
+                "(retrying next poll)", e,
+            )
+            return None
+        if latest is None:
+            return None
+        gen = str(latest["generation"])
+        with self._mu:
+            if gen in (self.current, self._failed, self._held_back):
+                return None
+        gen_dir = os.path.join(self.watch_dir, gen)
+        try:
+            return self._rollout(gen, gen_dir)
+        except Exception as e:  # pragma: no cover - defensive
+            logger.error("rollout of %s failed unexpectedly: %s", gen, e)
+            return self._halt(gen, f"unexpected error: {e}")
+
+    def _rollout(self, gen: str, gen_dir: str) -> Optional[str]:
+        lb = self.lb
+        n = len(lb.replicas)
+        ok_idx = [i for i in range(n) if self._replica_ok(i)]
+        with self._mu:
+            self._stats["rollouts_started_total"] += 1
+            self._in_progress = True
+            self._phase = "starting"
+        # A hot-swap arriving while a replica is mid-restart WAITS: the
+        # rollout needs the whole (non-written-off) fleet serving, so
+        # it halts and retries once the supervisor readmits the
+        # replica — never racing a relaunch with a reload.
+        not_ready = [i for i in ok_idx if not lb.breakers[i].eligible()]
+        if not ok_idx or not_ready:
+            return self._halt(
+                gen,
+                f"replicas not serving: {not_ready or 'all written off'}",
+            )
+        completed: List[int] = []
+        if self.canary is not None and len(ok_idx) < 2:
+            if len(lb.replicas) >= 2:
+                # Configured for canarying but degraded below a live
+                # pair: never roll an unvetted candidate onto the only
+                # serving replica — wait for the supervisor to restore
+                # a peer, then evaluate properly.
+                return self._halt(
+                    gen, "canary gate needs >= 2 serving replicas "
+                    f"(only {len(ok_idx)} left)",
+                )
+            # A deliberately single-replica fleet cannot canary (there
+            # is no live side to hold out) — proceed, loudly.
+            logger.warning(
+                "single-replica fleet: canary gate impossible, "
+                "rolling %s without evaluation", gen,
+            )
+        if self.canary is not None and len(ok_idx) >= 2:
+            verdict = self._canary_phase(ok_idx[0], gen, gen_dir)
+            if verdict == "held_back":
+                with self._mu:
+                    self._held_back = gen
+                    self._stats["canary"]["holdbacks_total"] += 1
+                    self._in_progress = False
+                    self._phase = "held_back"
+                    cur = self.current
+                logger.error(
+                    "canary HELD BACK %s: live generation %s keeps "
+                    "serving everywhere; candidate left on disk at %s",
+                    gen, cur, gen_dir,
+                )
+                return None
+            if verdict == "stage_failed":
+                return self._stage_failed(gen)
+            if verdict != "pass":
+                return self._halt(gen, f"canary: {verdict}")
+            completed.append(ok_idx[0])
+        for i in ok_idx:
+            if i in completed:
+                continue
+            try:
+                faults.fire("fleet.rollout_step")
+            except Exception as e:
+                return self._halt(gen, f"rollout step fault: {e}")
+            with self._mu:
+                self._stats["rollout_steps_total"] += 1
+                self._phase = "rolling"
+            if not self._replica_ok(i) or not lb.breakers[i].eligible():
+                # Replica killed mid-rollout: halt — the old generation
+                # keeps serving on every un-swapped replica, and the
+                # next poll retries once the fleet is whole.
+                return self._halt(gen, f"replica {i} unavailable")
+            # Hold only when a SERVING peer can absorb the drained
+            # traffic: written-off replicas don't count, so the sole
+            # survivor of a degraded fleet is never held (its reload
+            # stages off the request path anyway).
+            res = self._swap_replica(
+                i, gen, gen_dir, hold=len(ok_idx) > 1
+            )
+            if res == "stage_failed":
+                return self._stage_failed(gen)
+            if res != "ok":
+                return self._halt(gen, f"replica {i}: {res}")
+        with self._mu:
+            self.current = gen
+            self.current_dir = gen_dir
+            self._stats["rollouts_completed_total"] += 1
+            self._in_progress = False
+            self._phase = "idle"
+        if self.on_generation is not None:
+            self.on_generation(gen, gen_dir)
+        logger.info(
+            "rollout complete: fleet promoted to %s (%d replicas)",
+            gen, len(ok_idx),
+        )
+        return gen
+
+    def _halt(self, gen: str, reason: str) -> None:
+        """Transient abort: retried on a later poll (the pointer still
+        names the generation)."""
+        with self._mu:
+            self._stats["rollouts_halted_total"] += 1
+            self._in_progress = False
+            self._phase = "halted"
+            cur = self.current
+        logger.warning(
+            "rollout of %s HALTED: %s — old generation %s still "
+            "serving on un-swapped replicas; retrying on a later poll",
+            gen, reason, cur,
+        )
+        return None
+
+    def _stage_failed(self, gen: str) -> None:
+        """Permanent (until the pointer moves): the candidate failed
+        staging on a replica."""
+        with self._mu:
+            self._failed = gen
+            self._stats["generations_failed_total"] += 1
+            self._in_progress = False
+            self._phase = "failed"
+            cur = self.current
+        logger.error(
+            "rollout of %s ABORTED: staging failed; generation marked "
+            "failed (not retried until the pointer moves); %s keeps "
+            "serving", gen, cur,
+        )
+        return None
+
+    # -- per-replica swap ----------------------------------------------
+
+    def _post_replica(self, i: int, path: str, payload,
+                      timeout: Optional[float] = None,
+                      shadow: bool = False):
+        """Direct POST to one replica (NOT through the balancer's
+        rotation): the rollout/canary control channel."""
+        with self.lb._mu:
+            host, port = self.lb.replicas[i]
+        body = (
+            payload if isinstance(payload, (bytes, bytearray))
+            else json.dumps(payload).encode()
+        )
+        headers = {"Content-Type": "application/json"}
+        if shadow:
+            # Tag control/scoring traffic so a replica's access view
+            # (and the stub replicas in tests) can tell shadow traffic
+            # from live traffic that must never reach a held canary.
+            headers["X-Glint-Shadow"] = "1"
+        conn = http.client.HTTPConnection(
+            host, port,
+            timeout=self.step_timeout if timeout is None else timeout,
+        )
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            try:
+                doc = json.loads(data.decode() or "null")
+            except ValueError:
+                doc = None
+            return resp.status, doc
+        finally:
+            conn.close()
+
+    def _replica_metrics(self, i: int) -> Tuple[Optional[str], int, bool]:
+        """(generation, post_warmup_compiles, healthy) of one replica."""
+        try:
+            status, snap = self.lb._get_json(i, "/metrics")
+            hstatus, _ = self.lb._get_json(i, "/healthz")
+        except Exception:
+            return None, -1, False
+        if status != 200:
+            return None, -1, False
+        gen = (snap.get("hot_swap") or {}).get("generation")
+        compiles = int((snap.get("compiles") or {}).get("post_warmup") or 0)
+        return gen, compiles, hstatus == 200
+
+    def _wait_replica_on(self, i: int, gen: str,
+                         compiles_before: int) -> str:
+        """Poll until the replica serves ``gen``, healthy, with NO
+        post-warmup compiles added by the swap. Returns "ok" or a
+        reason string."""
+        deadline = time.monotonic() + self.step_timeout
+        while time.monotonic() < deadline and not self._stop.is_set():
+            rgen, compiles, healthy = self._replica_metrics(i)
+            if rgen == gen and healthy:
+                if compiles_before >= 0 and compiles > compiles_before:
+                    return (
+                        f"swap added {compiles - compiles_before} "
+                        "post-warmup compiles"
+                    )
+                return "ok"
+            time.sleep(0.1)
+        return f"not healthy on {gen} within {self.step_timeout:.0f}s"
+
+    def _swap_replica(self, i: int, gen: str, gen_dir: str,
+                      hold: bool) -> str:
+        """One rollout step: drain via breaker hold, reload, wait
+        healthy + warm, readmit. Returns "ok", "stage_failed", or a
+        transient reason. Single-replica fleets skip the hold — with
+        no peer to absorb traffic, ejecting the only replica would
+        drop availability to zero, and the reload stages off the
+        request path anyway."""
+        b = self.lb.breakers[i]
+        _, compiles_before, _ = self._replica_metrics(i)
+        if hold:
+            b.hold()
+            time.sleep(self.drain_seconds)  # in-flight requests drain
+        try:
+            try:
+                status, resp = self._post_replica(
+                    i, "/reload", {"dir": gen_dir, "generation": gen},
+                    shadow=True,
+                )
+            except Exception as e:
+                return f"unreachable during reload: {e}"
+            if status == 503:
+                # Transient staging trouble (storage hiccup on an
+                # existing dir, answered 503 by the replica): halt and
+                # retry the rollout on a later poll — branding the
+                # generation failed is for REJECTED staging only.
+                return f"transient staging error: {resp}"
+            if status != 200:
+                logger.error(
+                    "replica %d rejected %s: http %d %s",
+                    i, gen, status, resp,
+                )
+                return "stage_failed"
+            return self._wait_replica_on(i, gen, compiles_before)
+        finally:
+            if hold:
+                b.release()
+
+    # -- shadow canary -------------------------------------------------
+
+    def _score_probe(self, ci: int, probe: dict) -> Optional[float]:
+        """One deterministic probe: POST the same body to the live
+        fleet (the held canary is excluded from rotation by
+        construction) and to the canary; score top-k agreement."""
+        path = str(probe.get("path", "/synonyms"))
+        body = json.dumps(probe.get("body", {})).encode()
+        try:
+            lstatus, lbody, _ = self.lb.forward("POST", path, body)
+            cstatus, cdoc = self._post_replica(
+                ci, path, body, timeout=30.0, shadow=True
+            )
+        except Exception:
+            return None
+        if lstatus in _SHED_STATUSES or cstatus in _SHED_STATUSES:
+            # Backpressure is not a model answer: an overloaded-but-
+            # healthy fleet must not hold back a good candidate.
+            return None
+        if lstatus != 200 and cstatus != 200:
+            return None  # unscorable on both sides (e.g. shared OOV)
+        if lstatus != 200 or cstatus != 200:
+            return 0.0  # one-sided SEMANTIC failure is disagreement
+        try:
+            ldoc = json.loads(lbody)
+        except ValueError:
+            return None
+        return _topk_overlap(ldoc, cdoc, self.canary.top_k)
+
+    def _canary_phase(self, ci: int, gen: str, gen_dir: str) -> str:
+        """Stage the candidate on ONE held replica, mirror a sampled
+        slice of live traffic to it, score agreement, and decide.
+        Returns "pass", "held_back", "stage_failed", or a transient
+        reason. The held replica serves NO live traffic throughout —
+        the candidate generation cannot reach a client until it
+        passes."""
+        lb = self.lb
+        b = lb.breakers[ci]
+        with self._mu:
+            self._stats["canary"]["evaluations_total"] += 1
+            self._phase = "canary"
+        b.hold()
+        mirroring = False
+        restored = True
+        try:
+            _, compiles_before, _ = self._replica_metrics(ci)
+            time.sleep(self.drain_seconds)
+            # From the moment the reload is POSTed the replica may
+            # have adopted the candidate (the handler swaps before
+            # answering): pessimistically un-restored until a path
+            # below proves the live generation is back.
+            restored = False
+            try:
+                status, resp = self._post_replica(
+                    ci, "/reload", {"dir": gen_dir, "generation": gen},
+                    shadow=True,
+                )
+            except Exception as e:
+                # The reload may have been APPLIED with the response
+                # lost — restore before ever releasing the hold.
+                restored = self._restore_canary(ci, gen)
+                return f"canary unreachable during reload: {e}"
+            if status == 503:
+                # Transient staging trouble on the replica (storage
+                # hiccup): the old tables stayed live — retry the
+                # whole rollout on a later poll.
+                restored = True
+                return f"canary transient staging error: {resp}"
+            if status != 200:
+                logger.error(
+                    "canary replica %d rejected %s: http %d %s",
+                    ci, gen, status, resp,
+                )
+                restored = True  # staging rejected: old tables live
+                return "stage_failed"
+            warm = self._wait_replica_on(ci, gen, compiles_before)
+            if warm != "ok":
+                # The candidate IS live on the canary but never proved
+                # healthy/warm: restore before releasing the hold.
+                restored = self._restore_canary(ci, gen)
+                return f"canary {warm}"
+            scores: List[float] = []
+            for probe in (self.canary.probes or []):
+                s = self._score_probe(ci, probe)
+                if s is not None:
+                    scores.append(s)
+            lb.start_mirror(
+                self.canary.mirror_paths, self.canary.mirror_every
+            )
+            mirroring = True
+            deadline = time.monotonic() + self.canary.mirror_seconds
+            want = max(self.canary.min_scores, len(scores))
+            while (len(scores) < want
+                   and time.monotonic() < deadline
+                   and not self._stop.is_set()):
+                drained = lb.drain_mirror(16)
+                if not drained:
+                    time.sleep(0.05)
+                    continue
+                for path, body, lstatus, lbody in drained:
+                    if lstatus != 200:
+                        continue
+                    try:
+                        cstatus, cdoc = self._post_replica(
+                            ci, urlparse(path).path, body,
+                            timeout=30.0, shadow=True,
+                        )
+                        if cstatus in _SHED_STATUSES:
+                            continue  # backpressure, not an answer
+                        if cstatus != 200:
+                            scores.append(0.0)
+                            continue
+                        s = _topk_overlap(
+                            json.loads(lbody), cdoc, self.canary.top_k
+                        )
+                        if s is not None:
+                            scores.append(s)
+                    except Exception:
+                        continue
+            lb.stop_mirror()
+            mirroring = False
+            agreement = (
+                sum(scores) / len(scores) if scores else None
+            )
+            ok = (
+                agreement is None
+                or agreement >= self.canary.agreement_gate
+            )
+            with self._mu:
+                can = self._stats["canary"]
+                can["last_agreement"] = (
+                    round(agreement, 4) if agreement is not None else None
+                )
+                can["last_scored"] = len(scores)
+                can["last_generation"] = gen
+                can["last_verdict"] = "pass" if ok else "held_back"
+            if agreement is None:
+                logger.warning(
+                    "canary for %s collected no scoreable responses "
+                    "(no live traffic, no probes) — passing vacuously",
+                    gen,
+                )
+            if ok:
+                logger.info(
+                    "canary PASSED for %s: agreement %.3f >= %.3f "
+                    "over %d responses",
+                    gen, agreement if agreement is not None else 1.0,
+                    self.canary.agreement_gate, len(scores),
+                )
+                restored = True  # it now serves the PROMOTED generation
+                return "pass"
+            # Hold-back: restore the canary to the live generation so
+            # the candidate never serves a client, then count it.
+            restored = self._restore_canary(ci, gen)
+            return "held_back"
+        finally:
+            if mirroring:
+                lb.stop_mirror()
+            if restored:
+                b.release()
+            # NOT restored: the canary still holds the regressed
+            # candidate — it stays held (no live traffic) for the
+            # operator; the README runbook documents recovery.
+
+    def _restore_canary(self, ci: int, candidate: str) -> bool:
+        """Reload the canary back to the live generation after a
+        hold-back. Retried a few times; on total failure the replica
+        is left HELD (serving nothing) rather than ever exposing the
+        regressed candidate to clients."""
+        with self._mu:
+            prev_gen, prev_dir = self.current, self.current_dir
+        if prev_dir is None:
+            logger.error(
+                "no previous generation dir to restore canary from "
+                "(booted outside the publish protocol?) — replica "
+                "stays held",
+            )
+            return False
+        for _ in range(3):
+            try:
+                status, _ = self._post_replica(
+                    ci, "/reload",
+                    {"dir": prev_dir, "generation": prev_gen},
+                    shadow=True,
+                )
+                if status == 200 and self._wait_replica_on(
+                        ci, prev_gen, -1) == "ok":
+                    logger.info(
+                        "canary restored to %s after holding back %s",
+                        prev_gen, candidate,
+                    )
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.5)
+        logger.error(
+            "canary restore to %s FAILED after holding back %s — "
+            "replica left held out of rotation", prev_gen, candidate,
+        )
+        return False
+
+    # -- observability -------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._mu:
+            out = {
+                k: v for k, v in self._stats.items() if k != "canary"
+            }
+            out["canary"] = dict(self._stats["canary"])
+            out["in_progress"] = self._in_progress
+            out["phase"] = self._phase
+            out["generation"] = self.current
+            out["failed_generation"] = self._failed
+            out["held_back_generation"] = self._held_back
+            return out
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="glint-fleet-rollout",
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_seconds):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ----------------------------------------------------------------------
+# Fleet supervisor + launcher
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class _ReplicaSlot:
+    """One supervised replica slot: the live process, its launch
+    generation (the /healthz handshake value), and restart pacing."""
+
+    index: int
+    state: str = "starting"   # starting | up | backoff | failed | stopped
+    proc: Optional[subprocess.Popen] = None
+    launch_generation: int = -1
+    port_file: str = ""
+    host: Optional[str] = None
+    port: Optional[int] = None
+    restarts: int = 0
+    relaunch_at: float = 0.0
+    started_at: float = 0.0
+    detect_t: Optional[float] = None
+    last_reason: Optional[str] = None
+    restart_records: List[dict] = field(default_factory=list)
+
+    def gen_tag(self) -> str:
+        return f"{self.index}.{self.launch_generation}"
+
+
+class FleetSupervisor:
+    """Self-healing serving fleet: supervised replicas behind a
+    breaker-aware balancer, with coordinated rolling rollout.
+
+    The PR 7 supervisor pattern on the serving tier: replica liveness
+    is watched via ``waitpid`` (crash) AND the balancer's active
+    prober (hang — a replica whose probes fail continuously for
+    ``hang_kill_seconds`` while its process still runs is killed and
+    treated as crashed). Dead replicas relaunch from the fleet's
+    CURRENT model directory under capped exponential backoff and a
+    per-replica ``max_restarts`` budget; a replica out of budget is
+    left down (the balancer serves from the survivors) and counted on
+    ``/metrics``. Every launch exports ``GLINT_FLEET_GEN``; the
+    replica echoes it on ``/healthz`` and in its port file, so a stale
+    process or port file can never be adopted as the new incarnation.
+
+    With ``watch_dir`` (coordinated mode, the default), replicas do
+    NOT watch the publish dir themselves — the
+    :class:`RolloutCoordinator` orders every swap one replica at a
+    time, gated by the shadow canary when configured. A relaunched
+    replica boots from the fleet's current (promoted) generation, so
+    a restart mid-rollout converges with the coordinator instead of
+    racing it.
+    """
+
+    #: ``lb`` and ``coordinator`` are written exactly once (in run(),
+    #: before the supervision loop and any metrics request can touch
+    #: them) and read-only afterwards; lock-free reads see either None
+    #: (ignored) or the final object.
+    _ATOMIC_ATTRS = frozenset({"lb", "coordinator"})
+
+    def __init__(
+        self,
+        model_dir: Optional[str],
+        *,
+        replicas: int = 2,
+        host: str = "127.0.0.1",
+        port: int = 8800,
+        watch_dir: Optional[str] = None,
+        watch_poll: float = 1.0,
+        replica_flags: Optional[List[str]] = None,
+        log_dir: Optional[str] = None,
+        ready_timeout: float = 900.0,
+        port_file: Optional[str] = None,
+        max_restarts: int = 3,
+        backoff_base_seconds: float = 1.0,
+        backoff_cap_seconds: float = 30.0,
+        hang_kill_seconds: float = 10.0,
+        poll_interval: float = 0.25,
+        kill_grace_seconds: float = 5.0,
+        probe_interval: float = 0.5,
+        probe_timeout: float = 2.0,
+        breaker_failures: int = 3,
+        breaker_successes: int = 2,
+        breaker_open_seconds: float = 2.0,
+        canary: Optional[CanaryConfig] = None,
+        rollout_step_timeout: float = 600.0,
+        coordinated: bool = True,
+        build_replica_argv: Optional[Callable[[int, str], List[str]]] = None,
+        replica_env_first_launch: Optional[Dict[int, Dict[str, str]]] = None,
+    ):
+        if model_dir is None and watch_dir is None \
+                and build_replica_argv is None:
+            raise ValueError("model_dir or watch_dir required")
+        self.model_dir = model_dir
+        self.num_replicas = max(1, int(replicas))
+        self.host, self.port = host, int(port)
+        self.watch_dir = watch_dir
+        self.watch_poll = float(watch_poll)
+        self.replica_flags = list(replica_flags or [])
+        self.log_dir = log_dir
+        self.ready_timeout = float(ready_timeout)
+        self.port_file = port_file
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_seconds = float(backoff_base_seconds)
+        self.backoff_cap_seconds = float(backoff_cap_seconds)
+        self.hang_kill_seconds = float(hang_kill_seconds)
+        self.poll_interval = float(poll_interval)
+        self.kill_grace_seconds = float(kill_grace_seconds)
+        self.probe_interval = float(probe_interval)
+        self.probe_timeout = float(probe_timeout)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_successes = int(breaker_successes)
+        self.breaker_open_seconds = float(breaker_open_seconds)
+        self.canary = canary
+        self.rollout_step_timeout = float(rollout_step_timeout)
+        self.coordinated = bool(coordinated)
+        self._build_replica_argv = build_replica_argv
+        self.replica_env_first_launch = dict(replica_env_first_launch or {})
+        self._mu = threading.Lock()
+        self._slots = [
+            _ReplicaSlot(index=i) for i in range(self.num_replicas)
+        ]
+        self._restarts_total = 0
+        #: Model directory replicas (re)launch from; the rollout
+        #: coordinator advances it on every promoted generation.
+        self._current_model_dir = model_dir
+        self._logs: List = []
+        self._tmp: Optional[str] = None
+        self._stop = threading.Event()
+        #: Set once the balancer + prober (+ coordinator) are live —
+        #: the test/readiness barrier.
+        self.ready = threading.Event()
+        self.lb: Optional[LoadBalancer] = None
+        self.coordinator: Optional[RolloutCoordinator] = None
+
+    # -- replica launch ------------------------------------------------
+
+    def _default_replica_argv(self, index: int,
+                              port_file: str) -> List[str]:
+        argv = [
+            sys.executable, "-m", "glint_word2vec_tpu.cli", "serve",
+            "--host", "127.0.0.1", "--port", "0",
+            "--port-file", port_file,
+        ]
+        with self._mu:
+            model = self._current_model_dir
+        if self.coordinated or self.watch_dir is None:
+            # Coordinated mode: the replica serves ONE generation and
+            # swaps only when the rollout coordinator orders it.
+            argv += ["--model", model]
+        else:
+            # Legacy uncoordinated mode: every replica follows the
+            # publish dir itself (simultaneous fleet-wide swaps).
+            if model:
+                argv += ["--model", model]
+            argv += [
+                "--watch-checkpoint", self.watch_dir,
+                "--watch-poll", str(self.watch_poll),
+            ]
+        return argv + list(self.replica_flags)
+
+    def _argv(self, index: int, port_file: str) -> List[str]:
+        if self._build_replica_argv is not None:
+            return self._build_replica_argv(index, port_file)
+        return self._default_replica_argv(index, port_file)
+
+    def _open_log(self, index: int):
+        if not self.log_dir:
+            return None
+        os.makedirs(self.log_dir, exist_ok=True)
+        # graftlint: ignore[atomic-persist] append-mode process log, not an artifact
+        f = open(
+            os.path.join(self.log_dir, f"replica-{index}.log"), "ab"
+        )
+        self._logs.append(f)
+        return f
+
+    def _launch(self, slot: _ReplicaSlot) -> None:
+        slot.launch_generation += 1
+        slot.port_file = os.path.join(
+            self._tmp,
+            f"replica-{slot.index}.{slot.launch_generation}.port",
+        )
+        try:
+            os.remove(slot.port_file)
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env["GLINT_FLEET_GEN"] = slot.gen_tag()
+        if slot.launch_generation == 0:
+            # The chaos seam (PR 7's rank_env_first_launch pattern): a
+            # GLINT_FAULTS schedule armed here fires once and is NOT
+            # re-armed on the relaunch.
+            env.update(self.replica_env_first_launch.get(slot.index, {}))
+        log = self._open_log(slot.index)
+        if log is not None:
+            log.write(
+                f"\n===== launch generation {slot.launch_generation} "
+                f"replica {slot.index} =====\n".encode()
+            )
+            log.flush()
+        slot.proc = subprocess.Popen(
+            self._argv(slot.index, slot.port_file),
+            env=env, stdout=log, stderr=log and subprocess.STDOUT,
+            start_new_session=True,
+        )
+        slot.state = "starting"
+        slot.started_at = time.monotonic()
+        logger.info(
+            "fleet: replica %d launched (generation %s, pid %d)",
+            slot.index, slot.gen_tag(), slot.proc.pid,
+        )
+
+    def _read_port_file(self, slot: _ReplicaSlot) -> Optional[dict]:
+        """The replica's readiness file, generation-verified: a stale
+        file from a previous incarnation is never adopted."""
+        try:
+            with open(slot.port_file) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            return None
+        gen = info.get("fleet_generation")
+        if gen is not None and str(gen) != slot.gen_tag():
+            return None
+        return info
+
+    # -- supervision ---------------------------------------------------
+
+    def _schedule_restart(self, slot: _ReplicaSlot, reason: str) -> None:
+        now = time.monotonic()
+        with self._mu:
+            if slot.restarts >= self.max_restarts:
+                slot.state = "failed"
+                slot.last_reason = reason
+                logger.error(
+                    "fleet: replica %d FAILED (%s) with restart budget "
+                    "%d exhausted — left down, fleet serves from the "
+                    "survivors", slot.index, reason, self.max_restarts,
+                )
+                if self.lb is not None:
+                    self.lb.set_restarting(slot.index, False)
+                return
+            backoff = capped_backoff(
+                slot.restarts, self.backoff_base_seconds,
+                self.backoff_cap_seconds,
+            )
+            slot.restarts += 1
+            self._restarts_total += 1
+            slot.state = "backoff"
+            slot.relaunch_at = now + backoff
+            slot.detect_t = now
+            slot.last_reason = reason
+            slot.restart_records.append({
+                "reason": reason,
+                "backoff_seconds": round(backoff, 3),
+                "launch_generation": slot.launch_generation,
+                "detect_to_ready_seconds": None,
+            })
+        logger.error(
+            "fleet: replica %d DOWN (%s); restart %d/%d in %.1fs",
+            slot.index, reason, slot.restarts, self.max_restarts,
+            backoff,
+        )
+
+    def _adopt(self, slot: _ReplicaSlot, info: dict) -> None:
+        """A (re)launched replica published its generation-verified
+        port file: point the balancer at it and half-open its breaker
+        so the prober readmits it after M successes."""
+        slot.host = info.get("host", "127.0.0.1")
+        slot.port = int(info["port"])
+        self.lb.set_replica_address(
+            slot.index, slot.host, slot.port,
+            generation=slot.gen_tag(),
+        )
+        self.lb.set_restarting(slot.index, False)
+        self.lb.breakers[slot.index].clear_holds()
+        self.lb.breakers[slot.index].trial()
+        with self._mu:
+            slot.state = "up"
+            if slot.detect_t is not None and slot.restart_records:
+                slot.restart_records[-1]["detect_to_ready_seconds"] = (
+                    round(time.monotonic() - slot.detect_t, 3)
+                )
+                slot.detect_t = None
+        logger.info(
+            "fleet: replica %d ready on %s:%d (generation %s)",
+            slot.index, slot.host, slot.port, slot.gen_tag(),
+        )
+
+    def _sweep(self) -> None:
+        """One supervision pass over every slot."""
+        now = time.monotonic()
+        for slot in self._slots:
+            if slot.state in ("failed", "stopped"):
+                if slot.state == "failed" and self.lb is not None:
+                    # Keep the breaker firmly open: no trials against
+                    # a written-off address.
+                    self.lb.breakers[slot.index].force_open()
+                continue
+            rc = slot.proc.poll() if slot.proc is not None else None
+            if rc is not None and slot.state in ("up", "starting"):
+                if self._stop.is_set():
+                    slot.state = "stopped"
+                    continue
+                self.lb.set_restarting(slot.index, True)
+                self.lb.breakers[slot.index].force_open()
+                self._schedule_restart(
+                    slot,
+                    f"exited rc={rc}" if rc >= 0
+                    else f"killed by signal {-rc}",
+                )
+                continue
+            if slot.state == "up":
+                failing = self.lb.breakers[slot.index].failing_for()
+                if failing > self.hang_kill_seconds:
+                    # Hung: the process lives but probes have failed
+                    # continuously past the budget — put it down and
+                    # treat it as a crash.
+                    logger.error(
+                        "fleet: replica %d HUNG (probes failing for "
+                        "%.1fs) — killing pid %d", slot.index, failing,
+                        slot.proc.pid,
+                    )
+                    self.lb.set_restarting(slot.index, True)
+                    self.lb.breakers[slot.index].force_open()
+                    terminate_process(
+                        slot.proc, grace_seconds=self.kill_grace_seconds
+                    )
+                    self._schedule_restart(
+                        slot, f"hung ({failing:.1f}s of probe failures)"
+                    )
+                continue
+            if slot.state == "backoff":
+                self.lb.set_restarting(slot.index, True)
+                self.lb.breakers[slot.index].force_open()
+                if now >= slot.relaunch_at:
+                    self._launch(slot)
+                continue
+            if slot.state == "starting":
+                self.lb.set_restarting(slot.index, True)
+                self.lb.breakers[slot.index].force_open()
+                info = self._read_port_file(slot)
+                if info is not None:
+                    self._adopt(slot, info)
+                elif now - slot.started_at > self.ready_timeout:
+                    terminate_process(
+                        slot.proc, grace_seconds=self.kill_grace_seconds
+                    )
+                    self._schedule_restart(
+                        slot,
+                        f"not ready within {self.ready_timeout:.0f}s",
+                    )
+
+    # -- observability -------------------------------------------------
+
+    def _doc_extra(self) -> dict:
+        with self._mu:
+            states = [
+                {
+                    "replica": s.index,
+                    "state": s.state,
+                    "restarts": s.restarts,
+                    "launch_generation": s.launch_generation,
+                    "last_reason": s.last_reason,
+                    "restart_records": list(s.restart_records[-8:]),
+                }
+                for s in self._slots
+            ]
+            sup = {
+                "restarts_total": self._restarts_total,
+                "replicas_failed": sum(
+                    1 for s in self._slots if s.state == "failed"
+                ),
+                "max_restarts": self.max_restarts,
+                "replica_states": states,
+            }
+        doc = {"supervisor": sup}
+        if self.coordinator is not None:
+            doc["rollout"] = self.coordinator.stats()
+        return doc
+
+    def report(self) -> dict:
+        """Restart accounting in the shape the drill records."""
+        return self._doc_extra()
+
+    # -- main loop -----------------------------------------------------
+
+    def _resolve_boot(self) -> Optional[str]:
+        """The generation name the fleet boots from (None when booting
+        a plain model dir outside the publish protocol). Blocks until
+        a first committed generation exists when only ``watch_dir``
+        was given."""
+        from glint_word2vec_tpu.streaming.publish import resolve_latest
+
+        if self.model_dir is not None:
+            if self.watch_dir is not None:
+                md = os.path.abspath(self.model_dir)
+                if os.path.dirname(md) == os.path.abspath(self.watch_dir):
+                    return os.path.basename(md)
+            return None
+        if self.watch_dir is None:
+            return None  # custom build_replica_argv owns the boot
+        while not self._stop.is_set():
+            gen_dir = resolve_latest(self.watch_dir)
+            if gen_dir is not None:
+                with self._mu:
+                    self._current_model_dir = gen_dir
+                return os.path.basename(gen_dir)
+            logger.info(
+                "fleet: waiting for a first committed generation in %s",
+                self.watch_dir,
+            )
+            time.sleep(max(0.5, self.watch_poll))
+        return None
+
+    def _wait_initial_ready(self) -> None:
+        """Block until every replica published its generation-verified
+        port file; a replica dying before that is a boot error (fail
+        fast — the operator misconfigured the fleet)."""
+        deadline = time.time() + self.ready_timeout
+        for slot in self._slots:
+            while True:
+                if self._stop.is_set():
+                    return  # stop() during boot: run() exits promptly
+                info = self._read_port_file(slot)
+                if info is not None:
+                    slot.host = info.get("host", "127.0.0.1")
+                    slot.port = int(info["port"])
+                    slot.state = "up"
+                    break
+                if slot.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {slot.index} exited "
+                        f"rc={slot.proc.returncode} before binding its "
+                        "port"
+                    )
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"replica {slot.index} not ready in "
+                        f"{self.ready_timeout}s"
+                    )
+                time.sleep(0.1)
+
+    def run(self) -> int:
+        """Launch the fleet and supervise until shut down (POST
+        /shutdown on the balancer, SIGINT, or stop()). Returns 0 on a
+        clean shutdown."""
+        import tempfile
+
+        boot_gen: Optional[str] = None
+        with tempfile.TemporaryDirectory(prefix="glint_fleet_") as tmp:
+            self._tmp = tmp
+            try:
+                boot_gen = self._resolve_boot()
+                if self._stop.is_set():
+                    return 0
+                for slot in self._slots:
+                    self._launch(slot)
+                self._wait_initial_ready()
+                if self._stop.is_set():
+                    return 0
+                urls = [
+                    f"http://{s.host}:{s.port}" for s in self._slots
+                ]
+                self.lb = LoadBalancer(
+                    urls, host=self.host, port=self.port,
+                    breaker_failures=self.breaker_failures,
+                    breaker_successes=self.breaker_successes,
+                    breaker_open_seconds=self.breaker_open_seconds,
+                    probe_interval=self.probe_interval,
+                    probe_timeout=self.probe_timeout,
+                )
+                for slot in self._slots:
+                    self.lb.set_replica_address(
+                        slot.index, slot.host, slot.port,
+                        generation=slot.gen_tag(),
+                    )
+                self.lb.doc_extra = self._doc_extra
+                self.lb.on_shutdown = self._stop.set
+                if self.port_file:
+                    from glint_word2vec_tpu.utils import atomic_write_json
+
+                    atomic_write_json(
+                        self.port_file,
+                        {"host": self.lb.host, "port": self.lb.port},
+                    )
+                self.lb.start_background()
+                self.lb.start_prober()
+                if self.coordinated and self.watch_dir is not None:
+                    with self._mu:
+                        cur_dir = self._current_model_dir
+                    self.coordinator = RolloutCoordinator(
+                        self.lb, self.watch_dir,
+                        poll_seconds=self.watch_poll,
+                        current=boot_gen,
+                        current_dir=cur_dir,
+                        canary=self.canary,
+                        step_timeout=self.rollout_step_timeout,
+                        replica_ok=self._replica_ok,
+                        on_generation=self._on_generation,
+                    )
+                    self.coordinator.start()
+                logger.info(
+                    "fleet up: %d replicas (%s) behind %s:%d%s",
+                    self.num_replicas, ", ".join(urls),
+                    self.lb.host, self.lb.port,
+                    f", serving {boot_gen}" if boot_gen else "",
+                )
+                self.ready.set()
+                try:
+                    while not self._stop.is_set() \
+                            and not self.lb.stopped():
+                        self._sweep()
+                        time.sleep(self.poll_interval)
+                except KeyboardInterrupt:
+                    pass
+                return 0
+            finally:
+                self._stop.set()
+                self.ready.set()
+                if self.coordinator is not None:
+                    self.coordinator.stop()
+                if self.lb is not None:
+                    self.lb.stop()
+                for slot in self._slots:
+                    if slot.proc is not None:
+                        terminate_process(
+                            slot.proc,
+                            grace_seconds=self.kill_grace_seconds,
+                        )
+                for f in self._logs:
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+                self._logs = []
+                self._tmp = None
+
+    def _replica_ok(self, i: int) -> bool:
+        with self._mu:
+            return self._slots[i].state not in ("failed", "stopped")
+
+    def _on_generation(self, gen: str, gen_dir: str) -> None:
+        """Rollout coordinator promoted ``gen`` fleet-wide: relaunches
+        from now on boot from it (a replica restarting mid-rollout
+        converges instead of resurrecting an old generation)."""
+        with self._mu:
+            self._current_model_dir = gen_dir
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 def serve_fleet(
@@ -612,93 +2237,33 @@ def serve_fleet(
     log_dir: Optional[str] = None,
     ready_timeout: float = 900.0,
     port_file: Optional[str] = None,
+    **supervisor_kwargs,
 ) -> int:
-    """Launch ``replicas`` serving processes following one model (or
-    one publish dir) and front them with a :class:`LoadBalancer` in
-    this process until killed.
+    """Launch ``replicas`` supervised serving processes following one
+    model (or one publish dir) and front them with a breaker-aware
+    :class:`LoadBalancer` in this process until killed.
 
     Each replica binds an ephemeral port and signals readiness through
-    its ``--port-file`` — written only after the full serving warmup
-    (and ANN build + recall gate, when enabled), so the balancer's
-    first request never lands on a cold replica. ``replica_flags``
-    pass through to every ``cli serve`` invocation verbatim (ann
-    flags, cache size, overload bounds...). ``log_dir`` captures one
-    ``replica-N.log`` per process; default inherits stderr.
-
-    Returns the exit code (0 on clean shutdown). A dead replica is NOT
-    relaunched here — run replicas under ``cli supervise`` for that;
-    the balancer keeps serving from the survivors either way.
+    its generation-stamped ``--port-file`` — written only after the
+    full serving warmup (and ANN build + recall gate, when enabled),
+    so the balancer's first request never lands on a cold replica.
+    ``replica_flags`` pass through to every ``cli serve`` invocation
+    verbatim. Dead or hung replicas are relaunched by the
+    :class:`FleetSupervisor` under capped backoff and a restart
+    budget; with ``watch_dir``, generation moves are rolled out one
+    replica at a time behind the shadow-canary gate (see
+    ``supervisor_kwargs``: ``canary``, ``max_restarts``, breaker and
+    probe knobs, ...). Returns the exit code (0 on clean shutdown).
     """
-    import tempfile
-
-    replicas = max(1, int(replicas))
-    procs: List[subprocess.Popen] = []
-    logs = []
-    with tempfile.TemporaryDirectory(prefix="glint_fleet_") as tmp:
-        port_files = [
-            os.path.join(tmp, f"replica-{i}.port") for i in range(replicas)
-        ]
-        try:
-            for i in range(replicas):
-                stderr = None
-                if log_dir:
-                    os.makedirs(log_dir, exist_ok=True)
-                    # graftlint: ignore[atomic-persist] append-mode process log, not an artifact
-                    f = open(
-                        os.path.join(log_dir, f"replica-{i}.log"), "ab"
-                    )
-                    logs.append(f)
-                    stderr = f
-                procs.append(subprocess.Popen(
-                    _replica_argv(
-                        i, port_files[i], model_dir, watch_dir,
-                        replica_flags or [],
-                    ),
-                    stdout=stderr, stderr=stderr,
-                ))
-            urls = []
-            deadline = time.time() + ready_timeout
-            for i, pf in enumerate(port_files):
-                while not os.path.exists(pf):
-                    if procs[i].poll() is not None:
-                        raise RuntimeError(
-                            f"replica {i} exited rc={procs[i].returncode} "
-                            "before binding its port"
-                        )
-                    if time.time() > deadline:
-                        raise TimeoutError(
-                            f"replica {i} not ready in {ready_timeout}s"
-                        )
-                    time.sleep(0.1)
-                with open(pf) as f:
-                    info = json.load(f)
-                urls.append(f"http://{info['host']}:{info['port']}")
-            lb = LoadBalancer(urls, host=host, port=port)
-            if port_file:
-                from glint_word2vec_tpu.utils import atomic_write_json
-
-                atomic_write_json(
-                    port_file, {"host": lb.host, "port": lb.port}
-                )
-            logger.info(
-                "fleet up: %d replicas (%s) behind %s:%d",
-                replicas, ", ".join(urls), lb.host, lb.port,
-            )
-            try:
-                lb.serve_forever()
-            except KeyboardInterrupt:
-                lb.stop()
-            return 0
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.send_signal(signal.SIGTERM)
-            deadline = time.time() + 10
-            for p in procs:
-                try:
-                    p.wait(timeout=max(0.1, deadline - time.time()))
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    p.wait()
-            for f in logs:
-                f.close()
+    return FleetSupervisor(
+        model_dir,
+        replicas=replicas,
+        host=host,
+        port=port,
+        watch_dir=watch_dir,
+        replica_flags=replica_flags,
+        log_dir=log_dir,
+        ready_timeout=ready_timeout,
+        port_file=port_file,
+        **supervisor_kwargs,
+    ).run()

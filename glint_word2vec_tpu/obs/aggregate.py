@@ -259,6 +259,19 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         "last_checkpoint_age_seconds": None,
         "checkpoint_write_seconds": None,
     }
+    # Hot-swap block (ISSUES 10+14): counters sum; the swap age folds
+    # to the STALEST replica (the one a rolling rollout left behind is
+    # the actionable number); the generation is the fleet's only when
+    # every replica serves the same one — "mixed" is itself signal (a
+    # rollout in flight, or a halted one).
+    swap = {
+        "table_swaps_total": 0,
+        "swap_failures_total": 0,
+        "watch_errors_total": 0,
+        "last_swap_age_seconds": None,
+        "generation": None,
+    }
+    swap_gens = set()
     # ANN index block (ISSUE 12): counters sum; the recall and its
     # gate fold to the WORST replica (min recall, all-gates-pass) —
     # the actionable fleet numbers; ages/staleness fold to the
@@ -297,6 +310,17 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         comp = s.get("compiles") or {}
         for k in compiles:
             compiles[k] += int(comp.get(k) or 0)
+        hs = s.get("hot_swap") or {}
+        for k in ("table_swaps_total", "swap_failures_total",
+                  "watch_errors_total"):
+            swap[k] += int(hs.get(k) or 0)
+        v = hs.get("last_swap_age_seconds")
+        if v is not None:
+            swap["last_swap_age_seconds"] = (
+                v if swap["last_swap_age_seconds"] is None
+                else max(swap["last_swap_age_seconds"], v)
+            )
+        swap_gens.add(hs.get("generation"))
         sck = s.get("checkpoint") or {}
         ck["pending_async_saves"] += int(sck.get("pending_async_saves") or 0)
         for k in ("last_checkpoint_age_seconds",
@@ -347,6 +371,10 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         index["probes_per_query"] = round(
             index["probes_total"] / index["ann_queries_total"], 2
         )
+    if len(swap_gens) == 1:
+        swap["generation"] = next(iter(swap_gens))
+    elif swap_gens:
+        swap["generation"] = "mixed"
     return {
         "replicas": len(snaps),
         "endpoints": {p: endpoints[p] for p in sorted(endpoints)},
@@ -356,6 +384,7 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         "synonym_cache": cache,
         "overload": over,
         "compiles": compiles,
+        "hot_swap": swap,
         "checkpoint": ck,
         "index": index,
     }

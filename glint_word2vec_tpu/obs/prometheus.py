@@ -395,6 +395,12 @@ def serving_to_prometheus(snap: dict) -> str:
            "(the previous generation stayed live).")
     p.sample("glint_serving_swap_failures_total", None,
              swap.get("swap_failures_total", 0))
+    p.head("glint_serving_watch_errors_total", "counter",
+           "Transient publish-dir read errors the snapshot watcher "
+           "absorbed (backed off, retried on a later poll; the "
+           "generation was NOT marked failed).")
+    p.sample("glint_serving_watch_errors_total", None,
+             swap.get("watch_errors_total", 0))
     p.head("glint_serving_last_swap_age_seconds", "gauge",
            "Seconds since the last successful hot-swap (NaN before "
            "any).")
@@ -521,6 +527,47 @@ def fleet_to_prometheus(doc: dict) -> str:
         p.sample("glint_fleet_proxy_errors_total",
                  {"replica": r.get("url", "")},
                  r.get("proxy_errors_total", 0))
+    # Circuit breaker (ISSUE 14): per-replica state machine driven by
+    # the active health prober and the data plane's own connection
+    # verdicts — an ejected (open) replica costs zero client latency.
+    p.head("glint_fleet_breaker_state", "gauge",
+           "Per-replica circuit-breaker state: 1 on the row matching "
+           "the current state (closed replicas receive traffic, open "
+           "ones are ejected from rotation, half-open ones serve "
+           "prober trials only).")
+    for r in replicas:
+        br = r.get("breaker") or {}
+        for st in ("closed", "open", "half_open"):
+            p.sample("glint_fleet_breaker_state",
+                     {"replica": r.get("url", ""), "state": st},
+                     1 if br.get("state") == st else 0)
+    p.head("glint_fleet_breaker_held", "gauge",
+           "Whether the replica is administratively held out of "
+           "rotation (rollout drain / canary staging).")
+    for r in replicas:
+        br = r.get("breaker") or {}
+        p.sample("glint_fleet_breaker_held",
+                 {"replica": r.get("url", "")},
+                 1 if br.get("held") else 0)
+    for name, key, help_ in [
+        ("glint_fleet_breaker_opened_total", "opened_total",
+         "Closed -> open transitions (consecutive-failure ejections)."),
+        ("glint_fleet_breaker_reopened_total", "reopened_total",
+         "Half-open trial failures that re-opened the breaker."),
+        ("glint_fleet_breaker_closed_total", "closed_total",
+         "Half-open -> closed readmissions after the success "
+         "threshold."),
+        ("glint_fleet_probe_failures_total", "probe_failures_total",
+         "Active health probes that failed (connect error, non-200, "
+         "or a generation-handshake mismatch)."),
+        ("glint_fleet_probe_successes_total", "probe_successes_total",
+         "Active health probes answered healthy."),
+    ]:
+        p.head(name, "counter", help_)
+        for r in replicas:
+            br = r.get("breaker") or {}
+            p.sample(name, {"replica": r.get("url", "")},
+                     br.get(key, 0))
     bal = doc.get("balancer") or {}
     p.head("glint_fleet_shed_retries_total", "counter",
            "Requests retried on another replica after a 429/503 shed "
@@ -532,6 +579,100 @@ def fleet_to_prometheus(doc: dict) -> str:
            "was relayed to the client.")
     p.sample("glint_fleet_exhausted_total", None,
              bal.get("exhausted_total", 0))
+    p.head("glint_fleet_breaker_skips_total", "counter",
+           "Replica attempts avoided because the breaker was open or "
+           "held — each one a timeout a client did not pay.")
+    p.sample("glint_fleet_breaker_skips_total", None,
+             bal.get("breaker_skips_total", 0))
+    p.head("glint_fleet_restart_retries_total", "counter",
+           "Connection-refused attempts retried with jittered backoff "
+           "inside a known replica-restart window.")
+    p.sample("glint_fleet_restart_retries_total", None,
+             bal.get("restart_retries_total", 0))
+    # Fleet supervisor (ISSUE 14): replica relaunch accounting.
+    sup = doc.get("supervisor") or {}
+    p.head("glint_fleet_restarts_total", "counter",
+           "Replica relaunches by the fleet supervisor (crash or "
+           "hung-probe kill), all replicas.")
+    p.sample("glint_fleet_restarts_total", None,
+             sup.get("restarts_total", 0))
+    p.head("glint_fleet_replicas_failed", "gauge",
+           "Replicas whose restart budget is exhausted (left down; "
+           "the fleet serves from the survivors).")
+    p.sample("glint_fleet_replicas_failed", None,
+             sup.get("replicas_failed", 0))
+    p.head("glint_fleet_replica_restarts_total", "counter",
+           "Relaunches per replica slot.")
+    for rs in sup.get("replica_states") or []:
+        p.sample("glint_fleet_replica_restarts_total",
+                 {"replica": str(rs.get("replica", ""))},
+                 rs.get("restarts", 0))
+    p.head("glint_fleet_replica_state_info", "gauge",
+           "Fleet-supervisor state per replica slot carried as a "
+           "label; value is always 1.")
+    for rs in sup.get("replica_states") or []:
+        p.sample("glint_fleet_replica_state_info",
+                 {"replica": str(rs.get("replica", "")),
+                  "state": rs.get("state", "")}, 1)
+    # Rolling rollout + shadow canary (ISSUE 14).
+    ro = doc.get("rollout") or {}
+    for name, key, help_ in [
+        ("glint_fleet_rollouts_started_total", "rollouts_started_total",
+         "Generation rollouts the coordinator started."),
+        ("glint_fleet_rollouts_completed_total",
+         "rollouts_completed_total",
+         "Rollouts that promoted the generation to every replica."),
+        ("glint_fleet_rollouts_halted_total", "rollouts_halted_total",
+         "Rollouts halted mid-way (replica unavailable — retried once "
+         "the fleet is whole again)."),
+        ("glint_fleet_rollout_steps_total", "rollout_steps_total",
+         "Per-replica swap steps performed across all rollouts."),
+        ("glint_fleet_generations_failed_total",
+         "generations_failed_total",
+         "Candidate generations whose staging failed on a replica "
+         "(not retried until the pointer moves)."),
+        ("glint_fleet_watch_errors_total", "watch_errors_total",
+         "Transient publish-pointer read errors the rollout "
+         "coordinator absorbed."),
+    ]:
+        p.head(name, "counter", help_)
+        p.sample(name, None, ro.get(key, 0))
+    p.head("glint_fleet_rollout_in_progress", "gauge",
+           "Whether a rolling generation rollout is currently "
+           "executing.")
+    p.sample("glint_fleet_rollout_in_progress", None,
+             1 if ro.get("in_progress") else 0)
+    p.head("glint_fleet_generation_info", "gauge",
+           "Fleet-promoted generation carried as a label; value is "
+           "always 1.")
+    p.sample("glint_fleet_generation_info",
+             {"generation": ro.get("generation") or ""}, 1)
+    can = ro.get("canary") or {}
+    p.head("glint_fleet_canary_evaluations_total", "counter",
+           "Shadow-canary evaluations run against candidate "
+           "generations.")
+    p.sample("glint_fleet_canary_evaluations_total", None,
+             can.get("evaluations_total", 0))
+    p.head("glint_fleet_canary_holdbacks_total", "counter",
+           "Candidate generations held back by the canary gate "
+           "(regression: the rollout never proceeded; the live "
+           "generation kept serving everywhere).")
+    p.sample("glint_fleet_canary_holdbacks_total", None,
+             can.get("holdbacks_total", 0))
+    p.head("glint_fleet_canary_last_agreement", "gauge",
+           "Mean top-k agreement of the last canary evaluation "
+           "against the live fleet (NaN before any evaluation).")
+    p.sample("glint_fleet_canary_last_agreement", None,
+             can.get("last_agreement"))
+    p.head("glint_fleet_canary_agreement_gate", "gauge",
+           "Agreement threshold a candidate must clear to promote.")
+    p.sample("glint_fleet_canary_agreement_gate", None,
+             can.get("agreement_gate"))
+    p.head("glint_fleet_canary_last_scored", "gauge",
+           "Mirrored + probe responses scored in the last canary "
+           "evaluation.")
+    p.sample("glint_fleet_canary_last_scored", None,
+             can.get("last_scored", 0))
     # Per-replica index recall: the fleet view of the ISSUE 12 recall
     # gate (fleet-prefixed names — this exposition is concatenated
     # with serving_to_prometheus over the merged doc, and families in

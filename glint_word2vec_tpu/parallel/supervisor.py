@@ -61,6 +61,47 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def capped_backoff(restarts: int, base: float, cap: float) -> float:
+    """Capped exponential restart backoff: ``base * 2**restarts``,
+    never past ``cap``. Shared by the training-gang supervisor and the
+    serving-fleet supervisor (``fleet.FleetSupervisor``), so both tiers
+    pace their relaunches the same way."""
+    return min(float(base) * (2 ** int(restarts)), float(cap))
+
+
+def signal_process_group(proc: subprocess.Popen, sig) -> None:
+    """Signal a child's whole process group (catching any
+    grandchildren), falling back to the process itself when the group
+    is gone or was never created."""
+    try:
+        os.killpg(os.getpgid(proc.pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+def terminate_process(proc: subprocess.Popen,
+                      grace_seconds: float = 5.0) -> None:
+    """SIGTERM a child's process group, wait out the grace window,
+    SIGKILL whatever survives, and reap it. The single-process cousin
+    of the gang teardown — the serving-fleet supervisor uses it to put
+    down one hung replica without touching its siblings."""
+    if proc.poll() is not None:
+        return
+    signal_process_group(proc, signal.SIGTERM)
+    deadline = time.time() + max(0.0, grace_seconds)
+    while time.time() < deadline and proc.poll() is None:
+        time.sleep(0.05)
+    if proc.poll() is None:
+        signal_process_group(proc, signal.SIGKILL)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:  # pragma: no cover
+        logger.error("pid %d survived SIGKILL", proc.pid)
+
+
 def cli_train_build_argv(train_rest: List[str]) -> BuildArgv:
     """:data:`BuildArgv` for workers running ``python -m
     glint_word2vec_tpu.cli train <train_rest>`` — the ONE place the
@@ -353,13 +394,7 @@ class Supervisor:
 
     @staticmethod
     def _signal(proc: subprocess.Popen, sig) -> None:
-        try:
-            os.killpg(os.getpgid(proc.pid), sig)
-        except (ProcessLookupError, PermissionError, OSError):
-            try:
-                proc.send_signal(sig)
-            except (ProcessLookupError, OSError):
-                pass
+        signal_process_group(proc, sig)
 
     # -- failure detection ----------------------------------------------
 
@@ -564,8 +599,8 @@ class Supervisor:
                         "supervisor: giving up: %s", report.gave_up_reason
                     )
                     return report
-                backoff = min(
-                    self.backoff_base_seconds * (2 ** report.restarts),
+                backoff = capped_backoff(
+                    report.restarts, self.backoff_base_seconds,
                     self.backoff_cap_seconds,
                 )
                 logger.warning(

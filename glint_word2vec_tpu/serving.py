@@ -510,13 +510,46 @@ class SnapshotWatcher:
     staging verifies the matrix manifest besides, so a corrupt
     generation is a counted ``swap_failure`` (the previous tables stay
     live), never a bad serve. A failed generation is not retried until
-    the pointer moves again."""
+    the pointer moves again.
+
+    Transient storage trouble is NOT failure: a pointer or
+    generation-dir read error (mid-rename visibility on a network
+    filesystem, an NFS attribute-cache hiccup) backs off with a capped
+    doubling delay and retries on a later poll — counted as
+    ``watch_errors`` on ``/metrics`` — instead of either stalling the
+    watcher thread or permanently skipping a generation that is in
+    fact committed and fine."""
+
+    #: Transient-error backoff ceiling (seconds).
+    BACKOFF_CAP = 30.0
+    #: Consecutive polls a referenced generation directory may be
+    #: invisible before it is branded failed: on network filesystems
+    #: the directory rename's visibility can lag the pointer flip by a
+    #: beat (transient — retried with backoff), while an operator
+    #: deletion stays missing forever (permanent after the strikes).
+    MISSING_DIR_STRIKES = 2
+    #: Consecutive transient staging read errors (OSError inside an
+    #: EXISTING generation dir) tolerated for one generation before it
+    #: too is branded failed: storage hiccups clear within a few
+    #: backed-off polls; a permanently unreadable file (deleted shard,
+    #: permissions) does not, and must not retry forever.
+    STAGING_ERROR_STRIKES = 5
 
     def __init__(self, server: "ModelServer", watch_dir: str,
                  poll_seconds: float = 1.0):
         self.server = server
         self.watch_dir = watch_dir
         self.poll_seconds = max(0.05, float(poll_seconds))
+        #: Current transient-error backoff (seconds; 0 while healthy —
+        #: doubles per consecutive error up to BACKOFF_CAP, resets on
+        #: any successful poll).
+        self._backoff = 0.0
+        #: monotonic time before which polls are skipped (backoff).
+        self._retry_at = 0.0
+        #: (generation, consecutive polls its dir was missing).
+        self._missing = (None, 0)
+        #: (generation, consecutive transient staging read errors).
+        self._stage_errs = (None, 0)
         #: Generation name currently served (watcher-thread written;
         #: /reload reads it for its "unchanged" answer — a stale read
         #: only costs one redundant poll).
@@ -542,15 +575,68 @@ class SnapshotWatcher:
     def _poll_once_locked(self) -> Optional[str]:
         from glint_word2vec_tpu.streaming.publish import read_latest
 
-        latest = read_latest(self.watch_dir)
+        if time.monotonic() < self._retry_at:
+            return None  # backing off after a transient read error
+        try:
+            latest = read_latest(self.watch_dir, raise_errors=True)
+        except (OSError, ValueError) as e:
+            return self._watch_error_locked(f"unreadable pointer: {e}")
         if latest is None:
+            self._backoff = 0.0
             return None
         gen = str(latest["generation"])
         if gen == self.current or gen == self._failed:
+            self._backoff = 0.0
             return None
         gen_dir = os.path.join(self.watch_dir, gen)
+        if not os.path.isdir(gen_dir):
+            mgen, n = self._missing
+            n = n + 1 if mgen == gen else 1
+            self._missing = (gen, n)
+            if n < self.MISSING_DIR_STRIKES:
+                # First miss(es): rename-visibility lag on a network
+                # filesystem looks exactly like this — back off and
+                # look again before condemning the generation.
+                return self._watch_error_locked(
+                    f"referenced generation {gen} not visible yet "
+                    f"(miss {n}/{self.MISSING_DIR_STRIKES})"
+                )
+            # Still missing after the strikes: an operator deletion —
+            # branded failed and not retried until the pointer moves
+            # (the PR 10 contract).
+            logger.error(
+                "hot-swap of %s failed: generation directory missing "
+                "after %d polls", gen, n,
+            )
+            self.server.metrics.record_swap(gen, ok=False)
+            self._failed = gen
+            return None
+        self._missing = (None, 0)
         try:
             self.server.reload_generation(gen_dir, generation=gen)
+        except OSError as e:
+            # The directory EXISTS but a read inside it failed: the
+            # pointer only ever names committed generations, so this
+            # is transient storage trouble (mid-rename visibility, an
+            # NFS attribute-cache hiccup) — back off and retry the
+            # poll. Only a sustained run of read errors on the same
+            # generation brands it failed (a permanently unreadable
+            # file is not a hiccup).
+            sgen, n = self._stage_errs
+            n = n + 1 if sgen == gen else 1
+            self._stage_errs = (gen, n)
+            if n >= self.STAGING_ERROR_STRIKES:
+                logger.error(
+                    "hot-swap of %s failed: %d consecutive staging "
+                    "read errors (%s)", gen, n, e,
+                )
+                self.server.metrics.record_swap(gen, ok=False)
+                self._failed = gen
+                return None
+            return self._watch_error_locked(
+                f"transient read error staging {gen}: {e} "
+                f"(strike {n}/{self.STAGING_ERROR_STRIKES})"
+            )
         except Exception as e:
             logger.error("hot-swap of %s failed: %s", gen, e)
             self.server.metrics.record_swap(gen, ok=False)
@@ -558,7 +644,23 @@ class SnapshotWatcher:
             return None
         self.current = gen
         self._failed = None
+        self._backoff = 0.0
+        self._stage_errs = (None, 0)
         return gen
+
+    def _watch_error_locked(self, msg: str) -> None:
+        """Count one transient publish-dir read failure and arm the
+        capped-doubling retry delay; the watcher thread stays live and
+        the next eligible poll retries from scratch."""
+        self._backoff = min(
+            max(self.poll_seconds, self._backoff * 2), self.BACKOFF_CAP
+        )
+        self._retry_at = time.monotonic() + self._backoff
+        self.server.metrics.record_watch_error()
+        logger.warning(
+            "snapshot watcher: %s (retrying in %.1fs)", msg, self._backoff
+        )
+        return None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -625,6 +727,13 @@ class ModelServer:
     ):
         self.model = model
         self._prev_switch: Optional[float] = None
+        #: Fleet launch-generation handshake (PR 7 pattern, serving
+        #: tier): the fleet supervisor exports ``GLINT_FLEET_GEN`` on
+        #: every replica launch and this server echoes it on
+        #: ``/healthz`` and in its ``--port-file``, so a probe answered
+        #: by a stale pre-restart process (or a stale port file) can
+        #: never count as the NEW replica being healthy/ready.
+        self.fleet_generation = os.environ.get("GLINT_FLEET_GEN")
         # Device queries are jitted functions on shared tables; serialize
         # them (the reference's PS likewise processes a shard's requests
         # on its actor mailbox, one at a time). The synonym endpoints
@@ -772,6 +881,10 @@ class ModelServer:
                                 "ann_enabled": server._ann_live,
                                 "ann_recall_gate_ok":
                                     server.metrics.index_recall_gate_ok,
+                                "generation":
+                                    server.metrics.generation,
+                                "fleet_generation":
+                                    server.fleet_generation,
                             },
                         )
                     elif url.path == "/metrics":
@@ -853,6 +966,26 @@ class ModelServer:
                                 server.reload_generation(
                                     gen_dir, generation=gen
                                 )
+                            except OSError as e:
+                                if os.path.isdir(gen_dir):
+                                    # The dir EXISTS but a read inside
+                                    # it failed: transient storage
+                                    # trouble, answered 503 so a fleet
+                                    # rollout coordinator retries
+                                    # instead of branding the
+                                    # generation failed (the
+                                    # SnapshotWatcher classification,
+                                    # preserved across the HTTP
+                                    # boundary).
+                                    server.metrics.record_watch_error()
+                                    return self._send(
+                                        503,
+                                        {"error": "transient staging "
+                                                  f"error: {e}"},
+                                        headers={"Retry-After": "1"},
+                                    )
+                                server.metrics.record_swap(gen, ok=False)
+                                return self._send(400, {"error": str(e)})
                             except Exception as e:
                                 server.metrics.record_swap(gen, ok=False)
                                 return self._send(400, {"error": str(e)})
@@ -1073,6 +1206,7 @@ class ModelServer:
         from glint_word2vec_tpu.corpus.vocab import saved_model_vocabulary
         from glint_word2vec_tpu.models.word2vec import Word2VecModel
 
+        faults.fire("serving.reload")
         if type(self.model) is not Word2VecModel:
             raise ValueError(
                 f"hot-swap supports the base word-level family only "
@@ -1364,6 +1498,16 @@ def serve_model_dir(
             current = os.path.basename(md)
     if model is None:
         model = load_model(model_dir)
+    if current is None and model_dir is not None:
+        # Booting straight from a published generation dir (the fleet
+        # supervisor's coordinated relaunch path): stamp the served
+        # generation so the merged fleet view doesn't read "mixed"
+        # forever just because this process never hot-swapped.
+        from glint_word2vec_tpu.streaming.publish import _GEN_RE
+
+        base = os.path.basename(os.path.normpath(model_dir))
+        if _GEN_RE.match(base):
+            current = base
     server = ModelServer(
         model, host=host, port=port,
         max_batch=max_batch, warmup=warmup, cache_size=cache_size,
@@ -1376,11 +1520,22 @@ def serve_model_dir(
     )
     if watch_dir is not None:
         server.watch(watch_dir, poll_seconds=watch_poll, current=current)
+    elif current is not None:
+        server.metrics.generation = current
     if port_file:
         from glint_word2vec_tpu.utils import atomic_write_json
 
         atomic_write_json(
-            port_file, {"host": server.host, "port": server.port}
+            port_file,
+            {
+                "host": server.host,
+                "port": server.port,
+                # Launch-generation handshake: the fleet supervisor
+                # refuses a port file whose generation is not the one
+                # it just launched (a stale file from the previous
+                # incarnation must never be adopted as readiness).
+                "fleet_generation": server.fleet_generation,
+            },
         )
     try:
         server.serve_forever()

@@ -59,11 +59,22 @@ def generation_name(seq: int) -> str:
     return f"gen-{int(seq):06d}"
 
 
-def read_latest(publish_dir: str) -> Optional[dict]:
+def read_latest(publish_dir: str,
+                raise_errors: bool = False) -> Optional[dict]:
     """The ``LATEST.json`` pointer dict, or None when absent/unreadable.
-    The pointer is atomically replaced, so a reader can never see a
-    torn write — an unparseable file means a foreign artifact, logged
-    once per distinct error and treated as absent."""
+    The pointer is atomically replaced, so a local reader can never see
+    a torn write — an unparseable file means a foreign artifact, logged
+    once per distinct error and treated as absent.
+
+    ``raise_errors=True`` surfaces read/parse failures as the
+    ``OSError``/``ValueError`` they are instead of folding them into
+    "absent": on network filesystems a pointer read CAN fail or tear
+    transiently (mid-rename visibility, NFS attribute-cache hiccups),
+    and a caller with retry machinery — the serving
+    ``SnapshotWatcher``, the fleet rollout coordinator — wants to count
+    and back off rather than silently treat the hiccup as "no publish
+    yet". A genuinely missing pointer (``FileNotFoundError``) is the
+    normal no-publish-yet state and stays None in both modes."""
     path = os.path.join(publish_dir, LATEST_NAME)
     try:
         with open(path) as f:
@@ -71,9 +82,13 @@ def read_latest(publish_dir: str) -> Optional[dict]:
     except FileNotFoundError:
         return None
     except (OSError, ValueError) as e:
+        if raise_errors:
+            raise
         logger.warning("unreadable %s: %s", path, e)
         return None
     if not isinstance(latest, dict) or "generation" not in latest:
+        if raise_errors:
+            raise ValueError(f"malformed {path}: {latest!r}")
         logger.warning("malformed %s: %r", path, latest)
         return None
     return latest

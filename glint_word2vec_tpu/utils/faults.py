@@ -74,6 +74,12 @@ POINTS = {
         "just before a published generation directory's atomic rename",
     "publish.pre_pointer":
         "between the generation rename and the LATEST pointer flip",
+    "serving.reload":
+        "at the start of a serving hot-swap reload, before staging",
+    "fleet.replica_probe":
+        "once per fleet health probe of one serving replica",
+    "fleet.rollout_step":
+        "before each per-replica step of a rolling generation rollout",
 }
 
 _ACTIONS = ("exc", "kill", "hang", "delay")

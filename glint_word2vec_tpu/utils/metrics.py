@@ -392,6 +392,10 @@ class ServingMetrics:
         # into the live engine by the snapshot watcher / /reload.
         self.table_swaps = 0
         self.swap_failures = 0
+        #: Transient publish-dir read errors the snapshot watcher
+        #: absorbed (backed off and retried instead of marking the
+        #: generation failed).
+        self.watch_errors = 0
         self.last_swap_time: Optional[float] = None
         #: Name of the generation currently served (None until the
         #: first swap names one — a freshly-loaded model predates the
@@ -486,6 +490,14 @@ class ServingMetrics:
             else:
                 self.swap_failures += 1
 
+    def record_watch_error(self) -> None:
+        """One transient ``LATEST.json``/generation-dir read failure
+        the snapshot watcher absorbed: it backed off and will retry on
+        a later poll — the pointer was NOT marked failed and the
+        watcher thread did not stall."""
+        with self._mu:
+            self.watch_errors += 1
+
     def record_index_refresh(self, stats: dict, recall: Optional[float],
                              gate_ok: Optional[bool], gate: float,
                              nprobe: int) -> None:
@@ -573,6 +585,7 @@ class ServingMetrics:
                 "hot_swap": {
                     "table_swaps_total": self.table_swaps,
                     "swap_failures_total": self.swap_failures,
+                    "watch_errors_total": self.watch_errors,
                     "last_swap_age_seconds": (
                         round(time.time() - self.last_swap_time, 2)
                         if self.last_swap_time else None

@@ -1,0 +1,492 @@
+"""Fleet drill: the ISSUE 14 self-healing serving story, end to end.
+
+One REAL ``cli serve-fleet`` subprocess (2 supervised replicas behind
+the breaker-aware balancer, coordinated rollouts, canary gate armed
+with vienna/berlin + capital-of probes) is driven through three
+sub-drills under a closed-loop client load:
+
+  1. **kill-under-load** — replica 0 is armed with
+     ``GLINT_FAULTS=serving.dispatch:kill`` (first launch only, the
+     ``--replica0-env`` seam) and SIGKILLs itself mid-traffic. Gates:
+     the supervisor auto-restarts it within the backoff budget, fleet
+     availability never drops below N-1 replicas, and clients see zero
+     transport errors and zero non-backpressure 5xx.
+  2. **rolling-swap-under-load** — a new generation (bit-identical
+     copy, so the canary agreement is 1.0) is committed and the
+     pointer flipped. Gates: the rollout completes one replica at a
+     time, zero dropped requests, zero post-warmup compiles added,
+     every replica on the new generation, canary evaluated and passed.
+  3. **regressed-canary hold-back** — a candidate with a SHUFFLED
+     words file (valid to load, semantically garbage — the word->row
+     map is scrambled) is committed. Gates: the canary gate holds it
+     back, no non-canary replica ever stages it, the canary is
+     restored to the live generation, and the candidate stays on disk
+     for postmortem.
+
+Everything lands in ``FLEET_BENCH.json`` (exit nonzero on any gate
+failure) — the STREAM_BENCH analogue for the serving tier's fault
+drills. Env: GLINT_FLEET_DRILL_OUT overrides the artifact path.
+"""
+
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GLINT_CKPT_NO_FSYNC", "1")
+
+OUT = os.environ.get(
+    "GLINT_FLEET_DRILL_OUT", os.path.join(ROOT, "FLEET_BENCH.json")
+)
+
+PROBES = [
+    {"path": "/synonyms", "body": {"word": "vienna", "num": 10}},
+    {"path": "/synonyms", "body": {"word": "berlin", "num": 10}},
+    {"path": "/synonyms", "body": {"word": "austria", "num": 10}},
+    {"path": "/analogy", "body": {"positive": ["vienna", "germany"],
+                                  "negative": ["austria"], "num": 10}},
+]
+
+
+def _post(host, port, path, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(host, port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read())
+
+
+def _train_seed_model(tmp):
+    """A tiny capitals model, published as gen-000001."""
+    from conftest import _make_tiny_corpus
+
+    from glint_word2vec_tpu import Word2Vec
+
+    model = (
+        Word2Vec()
+        .set_vector_size(16).set_window_size(3).set_step_size(0.025)
+        .set_batch_size(256).set_num_negatives(5).set_min_count(5)
+        .set_num_iterations(2).set_seed(1).set_steps_per_call(4)
+    ).fit(_make_tiny_corpus())
+    pub = os.path.join(tmp, "publish")
+    os.makedirs(pub, exist_ok=True)
+    staging = os.path.join(tmp, "gen-000001.stage")
+    model.save(staging)
+    model.stop()
+    _commit_generation(pub, "gen-000001", staging)
+    return pub
+
+
+def _commit_generation(pub, gen, src_dir):
+    """The publish protocol by hand: temp dir + ONE rename + pointer."""
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    tmp_dir = os.path.join(pub, f"{gen}.tmp-{os.getpid()}")
+    if os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    shutil.copytree(src_dir, tmp_dir)
+    os.replace(tmp_dir, os.path.join(pub, gen))
+    atomic_write_json(
+        os.path.join(pub, "LATEST.json"),
+        {"generation": gen, "seq": int(gen.split("-")[1])},
+    )
+
+
+def _make_copy_generation(pub, src_gen, dst_gen):
+    _commit_generation(pub, dst_gen, os.path.join(pub, src_gen))
+
+
+def _make_regressed_generation(pub, src_gen, dst_gen):
+    """Copy ``src_gen`` but SHUFFLE words.txt: every file verifies
+    (the matrix manifest does not cover the words list), the model
+    loads — and the word->row mapping is garbage. The shape of a
+    pipeline bug the integrity layer cannot catch and the canary gate
+    exists for."""
+    staging = os.path.join(pub, f"{dst_gen}.stage")
+    if os.path.exists(staging):
+        shutil.rmtree(staging)
+    shutil.copytree(os.path.join(pub, src_gen), staging)
+    words_path = os.path.join(staging, "words.txt")
+    with open(words_path, encoding="utf-8") as f:
+        words = [w for w in f.read().splitlines() if w]
+    random.Random(0).shuffle(words)
+    # graftlint: ignore[atomic-persist] drill-private staging file, committed via _commit_generation's rename
+    with open(words_path, "w", encoding="utf-8") as f:
+        f.write("".join(w + "\n" for w in words))
+    _commit_generation(pub, dst_gen, staging)
+    shutil.rmtree(staging)
+
+
+class ClientLoad:
+    """Closed-loop /synonyms clients through the balancer + an
+    availability sampler on its /healthz."""
+
+    WORDS = ["austria", "germany", "france", "poland", "vienna",
+             "berlin", "paris", "warsaw"]
+
+    def __init__(self, host, port, clients=4):
+        self.host, self.port = host, port
+        self.clients = clients
+        self.lock = threading.Lock()
+        self.by_status = {}
+        self.dropped = 0
+        self.min_up = None
+        self.up_samples = []
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _client(self, i):
+        n = 0
+        while not self._stop.is_set():
+            word = self.WORDS[(n + i) % len(self.WORDS)]
+            n += 1
+            try:
+                code, _ = _post(self.host, self.port, "/synonyms",
+                                {"word": word, "num": 5}, timeout=30)
+            except Exception:
+                with self.lock:
+                    self.dropped += 1
+                continue
+            with self.lock:
+                self.by_status[code] = self.by_status.get(code, 0) + 1
+
+    def _sampler(self):
+        while not self._stop.is_set():
+            try:
+                h = _get_json(self.host, self.port, "/healthz",
+                              timeout=5)
+                up = int(h.get("replicas_up", 0))
+            except Exception:
+                up = -1  # balancer itself unreachable
+            with self.lock:
+                self.up_samples.append(up)
+                self.min_up = (
+                    up if self.min_up is None else min(self.min_up, up)
+                )
+            time.sleep(0.2)
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._client, args=(i,))
+            for i in range(self.clients)
+        ]
+        self._threads.append(threading.Thread(target=self._sampler))
+        for t in self._threads:
+            t.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=60)
+
+    def snapshot(self):
+        with self.lock:
+            return {
+                "by_status": dict(self.by_status),
+                "dropped": self.dropped,
+                "min_replicas_up": self.min_up,
+                "availability_samples": len(self.up_samples),
+            }
+
+
+def _wait(pred, timeout, msg, interval=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except Exception:
+            pass
+        time.sleep(interval)
+    print(f"TIMEOUT waiting for {msg}", file=sys.stderr)
+    return False
+
+
+def main() -> int:
+    import tempfile
+
+    t0 = time.time()
+    tmp = tempfile.mkdtemp(prefix="glint_fleet_drill_")
+    log_dir = os.path.join(tmp, "logs")
+    print("training seed model + publishing gen-000001 ...")
+    pub = _train_seed_model(tmp)
+
+    probes_path = os.path.join(tmp, "probes.json")
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    atomic_write_json(probes_path, PROBES)
+
+    port_file = os.path.join(tmp, "fleet.port")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GLINT_CKPT_NO_FSYNC": "1",
+    }
+    argv = [
+        sys.executable, "-m", "glint_word2vec_tpu.cli", "serve-fleet",
+        "--watch-checkpoint", pub, "--watch-poll", "0.3",
+        "--replicas", "2", "--port", "0", "--port-file", port_file,
+        "--replica-log-dir", log_dir,
+        "--max-batch", "8", "--cache-size", "0",
+        "--max-restarts", "3", "--backoff-base", "0.5",
+        "--backoff-cap", "5",
+        "--probe-interval", "0.1", "--probe-timeout", "2",
+        "--breaker-failures", "2", "--breaker-successes", "1",
+        "--breaker-open-seconds", "0.3",
+        "--canary-probes", probes_path,
+        "--canary-min-scores", "2", "--canary-mirror-seconds", "5",
+        "--canary-mirror-every", "2", "--canary-agreement", "0.6",
+        # Replica 0, FIRST launch only: SIGKILL at its 120th coalesced
+        # dispatch — the kill-under-load drill.
+        "--replica0-env", "GLINT_FAULTS=serving.dispatch:kill@120",
+    ]
+    print("starting serve-fleet:", " ".join(argv[2:]))
+    fleet = subprocess.Popen(argv, env=env, cwd=ROOT)
+    result = {"phases": {}}
+    checks = {}
+    load = None
+    try:
+        ok = _wait(lambda: os.path.exists(port_file), 600,
+                   "fleet port file")
+        assert ok, "fleet never became ready"
+        with open(port_file) as f:
+            lb = json.load(f)
+        host, port = lb["host"], lb["port"]
+
+        def doc():
+            return _get_json(host, port, "/metrics", timeout=30)
+
+        # ---- drill 1: kill under load -------------------------------
+        print("drill 1: kill-under-load ...")
+        load = ClientLoad(host, port, clients=4)
+        load.start()
+        restarted = _wait(
+            lambda: doc()["supervisor"]["restarts_total"] >= 1, 300,
+            "replica restart detected",
+        )
+        recovered = restarted and _wait(
+            lambda: all(
+                s["state"] == "up"
+                for s in doc()["supervisor"]["replica_states"]
+            ) and all(
+                r["breaker"]["state"] == "closed"
+                for r in doc()["replicas"]
+            ),
+            300, "relaunched replica readmitted",
+        )
+        time.sleep(2)  # post-recovery traffic through both replicas
+        kill_snap = load.snapshot()
+        d = doc()
+        restarts = d["supervisor"]["replica_states"][0]["restarts"]
+        rec = d["supervisor"]["replica_states"][0]["restart_records"]
+        result["phases"]["kill_under_load"] = {
+            "load": kill_snap,
+            "restarts_total": d["supervisor"]["restarts_total"],
+            "replica0_restarts": restarts,
+            "replica0_restart_records": rec,
+            "breaker0": d["replicas"][0]["breaker"],
+        }
+        bad_statuses = {
+            str(c): n for c, n in kill_snap["by_status"].items()
+            if int(c) not in (200, 404, 429, 503)
+        }
+        checks["kill_replica_restarted"] = bool(restarted)
+        checks["kill_replica_readmitted"] = bool(recovered)
+        checks["kill_restart_within_budget"] = (
+            restarted and 1 <= restarts <= 3
+        )
+        checks["kill_zero_dropped_requests"] = kill_snap["dropped"] == 0
+        checks["kill_zero_nonbackpressure_5xx"] = not bad_statuses
+        checks["kill_availability_never_below_n_minus_1"] = (
+            kill_snap["min_replicas_up"] is not None
+            and kill_snap["min_replicas_up"] >= 1
+        )
+
+        # ---- drill 2: rolling swap under load -----------------------
+        print("drill 2: rolling-swap-under-load ...")
+        _make_copy_generation(pub, "gen-000001", "gen-000002")
+        rolled = _wait(
+            lambda: doc()["rollout"]["generation"] == "gen-000002"
+            and doc()["rollout"]["rollouts_completed_total"] >= 1,
+            300, "rolling rollout completion",
+        )
+        time.sleep(2)
+        load.stop()
+        swap_snap = load.snapshot()
+        d = doc()
+        gens = [
+            ((r.get("snapshot") or {}).get("hot_swap") or {})
+            .get("generation")
+            for r in d["replicas"]
+        ]
+        post_warmup = (
+            ((d.get("fleet") or {}).get("compiles") or {})
+            .get("post_warmup")
+        )
+        result["phases"]["rolling_swap_under_load"] = {
+            "load": swap_snap,
+            "rollout": d["rollout"],
+            "replica_generations": gens,
+            "fleet_post_warmup_compiles": post_warmup,
+            "fleet_hot_swap": (d.get("fleet") or {}).get("hot_swap"),
+        }
+        bad_statuses = {
+            str(c): n for c, n in swap_snap["by_status"].items()
+            if int(c) not in (200, 404, 429, 503)
+        }
+        checks["swap_rollout_completed"] = bool(rolled)
+        checks["swap_all_replicas_on_new_generation"] = (
+            gens == ["gen-000002", "gen-000002"]
+        )
+        checks["swap_zero_dropped_requests"] = (
+            swap_snap["dropped"] == 0
+        )
+        checks["swap_zero_nonbackpressure_5xx"] = not bad_statuses
+        checks["swap_zero_post_warmup_compiles"] = post_warmup == 0
+        checks["swap_canary_evaluated_and_passed"] = (
+            d["rollout"]["canary"]["evaluations_total"] >= 1
+            and d["rollout"]["canary"]["holdbacks_total"] == 0
+            and (d["rollout"]["canary"]["last_agreement"] or 0) >= 0.6
+        )
+
+        # ---- drill 3: regressed canary hold-back --------------------
+        print("drill 3: regressed-canary hold-back ...")
+        _make_regressed_generation(pub, "gen-000002", "gen-000003")
+        held = _wait(
+            lambda: doc()["rollout"]["canary"]["holdbacks_total"] >= 1,
+            300, "canary hold-back",
+        )
+        # Let any in-flight restore settle, then take the final view.
+        time.sleep(2)
+        d = doc()
+        gens = [
+            ((r.get("snapshot") or {}).get("hot_swap") or {})
+            .get("generation")
+            for r in d["replicas"]
+        ]
+        result["phases"]["regressed_canary_holdback"] = {
+            "rollout": d["rollout"],
+            "replica_generations": gens,
+            "candidate_on_disk": os.path.isdir(
+                os.path.join(pub, "gen-000003")
+            ),
+        }
+        checks["canary_held_back_regression"] = bool(held)
+        checks["canary_no_replica_promoted_candidate"] = (
+            gens == ["gen-000002", "gen-000002"]
+        )
+        checks["canary_agreement_below_gate"] = (
+            d["rollout"]["canary"]["last_agreement"] is not None
+            and d["rollout"]["canary"]["last_agreement"] < 0.6
+        )
+        checks["canary_generation_not_current"] = (
+            d["rollout"]["generation"] == "gen-000002"
+            and d["rollout"]["held_back_generation"] == "gen-000003"
+        )
+        checks["canary_candidate_left_on_disk"] = os.path.isdir(
+            os.path.join(pub, "gen-000003")
+        )
+        checks["canary_all_breakers_closed_after"] = all(
+            r["breaker"]["state"] == "closed"
+            and not r["breaker"]["held"]
+            for r in d["replicas"]
+        )
+
+        # Prometheus rendering of the whole story stays lint-clean.
+        from glint_word2vec_tpu.obs.prometheus import (
+            lint_prometheus_text,
+        )
+
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics?format=prometheus",
+            timeout=30,
+        ) as r:
+            prom = r.read().decode()
+        lint_prometheus_text(prom)
+        checks["prometheus_exposition_lints"] = True
+        checks["prometheus_carries_fleet_families"] = all(
+            name in prom for name in (
+                "glint_fleet_breaker_state",
+                "glint_fleet_restarts_total",
+                "glint_fleet_rollouts_completed_total",
+                "glint_fleet_canary_holdbacks_total",
+            )
+        )
+
+        # ---- shutdown ----------------------------------------------
+        status, _ = _post(host, port, "/shutdown", {}, timeout=30)
+        checks["fanout_shutdown_ok"] = status == 200
+        try:
+            rc = fleet.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            rc = None
+        checks["fleet_clean_exit"] = rc == 0
+        result["fleet_exit_code"] = rc
+    finally:
+        if load is not None:
+            load.stop()
+        if fleet.poll() is None:
+            fleet.terminate()
+            try:
+                fleet.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                fleet.kill()
+                fleet.wait()
+
+    out = {
+        "schema_version": 1,
+        "drill": "fleet_selfheal_rollout_canary",
+        "platform": "cpu",
+        "fallback": (
+            "CPU container drill: 2 replicas + balancer + trainer "
+            "share 2 cores, so recovery latencies are load-bound, not "
+            "protocol-bound; the gates are correctness gates"
+        ),
+        "wall_seconds": round(time.time() - t0, 1),
+        "config": {
+            "replicas": 2, "clients": 4,
+            "max_restarts": 3, "backoff_base_seconds": 0.5,
+            "breaker": {"failures": 2, "successes": 1,
+                        "open_seconds": 0.3},
+            "probe_interval_seconds": 0.1,
+            "canary": {"agreement_gate": 0.6, "min_scores": 2,
+                       "mirror_every": 2, "probes": len(PROBES)},
+            "kill": "serving.dispatch:kill@120 on replica 0, first "
+                    "launch only",
+        },
+        "phases": result["phases"],
+        "fleet_exit_code": result.get("fleet_exit_code"),
+        "checks": checks,
+        "pass": all(checks.values()),
+    }
+    atomic_write_json(OUT, out, indent=2)
+    print(json.dumps({"checks": checks, "pass": out["pass"]}, indent=2))
+    print(f"artifact: {OUT}")
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1011 @@
+"""Self-healing fleet tests (ISSUE 14) with jax-free stub replicas:
+probe-driven circuit breaking (eject / half-open / readmit), keep-alive
+reconnect after a replica bounce, rolling generation rollout ordering
+and halt semantics, the shadow-canary promotion gate (pass + hold-back),
+fleet-supervisor relaunch of a dead subprocess replica, and the
+snapshot-watcher transient-error backoff satellite."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from glint_word2vec_tpu.fleet import (
+    CanaryConfig,
+    FleetSupervisor,
+    LoadBalancer,
+    ReplicaBreaker,
+    RolloutCoordinator,
+    _ReplicaConn,
+)
+from glint_word2vec_tpu.obs.prometheus import lint_prometheus_text
+from glint_word2vec_tpu.utils.metrics import ServingMetrics
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class StubReplica:
+    """In-process replica stand-in speaking just enough of the serving
+    surface for the balancer, prober, and rollout coordinator:
+    /healthz (fleet_generation echo, fail/hang switches), /metrics
+    (hot_swap.generation + compiles), /reload (records swaps, can
+    fail), /synonyms (per-generation answers; live vs shadow traffic
+    distinguished by the X-Glint-Shadow header)."""
+
+    def __init__(self, generation="gen-000001", fleet_generation=None,
+                 answers=None, port=0):
+        self.generation = generation
+        self.fleet_generation = fleet_generation
+        #: generation -> list of words /synonyms answers with.
+        self.answers = answers or {}
+        self.default_answer = ["a", "b", "c"]
+        self.healthz_fail = False
+        self.reload_fail = False
+        self.reload_transient = False
+        self.reload_delay = 0.0
+        self.reloads = []          # (generation, t_start, t_end)
+        self.synonyms_live = []    # (word, generation) non-shadow hits
+        self.synonyms_shadow = []  # (word, generation) shadow hits
+        self._mu = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if stub.healthz_fail:
+                        return self._send(503, {"status": "down"})
+                    return self._send(200, {
+                        "status": "ok",
+                        "fleet_generation": stub.fleet_generation,
+                        "generation": stub.generation,
+                        "post_warmup_compiles": 0,
+                    })
+                if self.path == "/metrics":
+                    return self._send(200, {
+                        "endpoints": {},
+                        "hot_swap": {"generation": stub.generation,
+                                     "table_swaps_total": len(stub.reloads),
+                                     "swap_failures_total": 0,
+                                     "watch_errors_total": 0},
+                        "compiles": {"total": 0, "warmup": 0,
+                                     "post_warmup": 0},
+                    })
+                if self.path == "/gen":
+                    return self._send(200, {"generation": stub.generation})
+                self._send(404, {"error": "no route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                shadow = self.headers.get("X-Glint-Shadow") == "1"
+                if self.path == "/reload":
+                    t0 = time.monotonic()
+                    if stub.reload_delay:
+                        time.sleep(stub.reload_delay)
+                    if stub.reload_transient:
+                        return self._send(
+                            503, {"error": "transient staging error"})
+                    if stub.reload_fail:
+                        return self._send(400, {"error": "stub refuses"})
+                    gen = req.get("generation") or os.path.basename(
+                        os.path.normpath(req.get("dir", "")))
+                    with stub._mu:
+                        stub.generation = gen
+                        stub.reloads.append((gen, t0, time.monotonic()))
+                    return self._send(200, {"status": "reloaded",
+                                            "generation": gen})
+                if self.path == "/synonyms":
+                    word = req.get("word", "")
+                    with stub._mu:
+                        gen = stub.generation
+                        (stub.synonyms_shadow if shadow
+                         else stub.synonyms_live).append((word, gen))
+                    words = stub.answers.get(gen, stub.default_answer)
+                    return self._send(
+                        200, [[w, 0.9 - 0.1 * i]
+                              for i, w in enumerate(words)])
+                if self.path == "/shutdown":
+                    self._send(200, {"status": "bye"})
+                    threading.Thread(
+                        target=stub.stop, daemon=True).start()
+                    return
+                self._send(404, {"error": "no route"})
+
+        self._handler = Handler
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def restart_same_port(self):
+        """Bounce: a fresh server on the SAME port (the keep-alive
+        stale-socket scenario)."""
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.port), self._handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+
+def _post(host, port, path, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(host, port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.read()
+
+
+def _make_pub(tmp_path, gen):
+    """A publish dir whose LATEST names ``gen`` (the dir just has to
+    exist — stub replicas never read it)."""
+    pub = tmp_path / "pub"
+    pub.mkdir(exist_ok=True)
+    (pub / gen).mkdir(exist_ok=True)
+    tmp = pub / "LATEST.json.tmp"
+    tmp.write_text(json.dumps({"generation": gen}))
+    os.replace(tmp, pub / "LATEST.json")
+    return str(pub)
+
+
+# ----------------------------------------------------------------------
+# ReplicaBreaker state machine
+# ----------------------------------------------------------------------
+
+
+def test_breaker_state_machine_open_halfopen_close():
+    b = ReplicaBreaker(fail_threshold=3, success_threshold=2,
+                       open_seconds=0.05)
+    assert b.state() == "closed" and b.eligible()
+    b.record_failure()
+    b.record_failure()
+    assert b.state() == "closed"  # under threshold
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state() == "closed"  # success reset the run
+    b.record_failure()
+    assert b.state() == "open" and not b.eligible()
+    assert not b.maybe_half_open()  # cooldown not elapsed
+    time.sleep(0.06)
+    assert b.maybe_half_open()
+    assert b.state() == "half_open"
+    # Half-open trial failure re-opens immediately.
+    b.record_failure()
+    assert b.state() == "open"
+    assert b.snapshot()["reopened_total"] == 1
+    time.sleep(0.06)
+    assert b.maybe_half_open()
+    b.record_success()
+    assert b.state() == "half_open"  # one of two
+    b.record_success()
+    assert b.state() == "closed" and b.eligible()
+    snap = b.snapshot()
+    assert snap["opened_total"] == 1 and snap["closed_total"] == 1
+
+
+def test_breaker_hold_blocks_eligibility_regardless_of_state():
+    b = ReplicaBreaker()
+    b.hold()
+    assert not b.eligible() and b.held() and b.state() == "closed"
+    b.release()
+    assert b.eligible()
+
+
+# ----------------------------------------------------------------------
+# Probe-driven ejection / readmission through the balancer
+# ----------------------------------------------------------------------
+
+
+def test_prober_ejects_dead_replica_and_readmits_after_recovery():
+    s1, s2 = StubReplica(), StubReplica()
+    lb = LoadBalancer(
+        [s1.url, s2.url], port=0,
+        breaker_failures=2, breaker_successes=2,
+        breaker_open_seconds=0.2, probe_interval=0.05,
+        probe_timeout=0.5,
+    )
+    lb.start_background()
+    lb.start_prober()
+    try:
+        _wait_for(lambda: lb.breakers[0].state() == "closed"
+                  and lb.breakers[1].state() == "closed",
+                  msg="both replicas probed healthy")
+        s2.stop()
+        _wait_for(lambda: lb.breakers[1].state() == "open",
+                  msg="dead replica ejected")
+        # Ejected: every request lands on the healthy replica with no
+        # connection errors paid on the dead one.
+        with lb._mu:
+            errors_at_open = lb._errors[1]
+        for i in range(6):
+            code, _ = _post(lb.host, lb.port, "/synonyms",
+                            {"word": f"w{i}", "num": 3})
+            assert code == 200
+        with lb._mu:
+            assert lb._errors[1] == errors_at_open, \
+                "client traffic still paid the dead replica"
+        stats = lb.balancer_stats()
+        assert stats["breaker_skips_total"] > 0
+        # Breaker state rides the merged exposition, lint-clean.
+        code, text = _get(lb.host, lb.port,
+                          "/metrics?format=prometheus")
+        text = text.decode()
+        lint_prometheus_text(text)
+        assert 'state="open"} 1' in text
+        assert "glint_fleet_breaker_skips_total" in text
+        # Recovery: half-open trials readmit after M successes.
+        s2.restart_same_port()
+        _wait_for(lambda: lb.breakers[1].state() == "closed",
+                  msg="bounced replica readmitted")
+        snap = lb.breakers[1].snapshot()
+        assert snap["closed_total"] >= 1
+    finally:
+        lb.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_half_open_trial_failure_reopens_through_prober():
+    s1 = StubReplica()
+    lb = LoadBalancer(
+        [s1.url], port=0,
+        breaker_failures=1, breaker_successes=1,
+        breaker_open_seconds=0.1, probe_interval=0.03,
+        probe_timeout=0.3,
+    )
+    lb.start_prober()
+    try:
+        s1.healthz_fail = True
+        _wait_for(lambda: lb.breakers[0].state() == "open",
+                  msg="breaker opened on failing healthz")
+        # Still failing: each cooldown expiry half-opens, the trial
+        # fails, and the breaker re-opens — counted.
+        _wait_for(lambda: lb.breakers[0].snapshot()["reopened_total"] >= 2,
+                  msg="half-open trials re-opening")
+        s1.healthz_fail = False
+        _wait_for(lambda: lb.breakers[0].state() == "closed",
+                  msg="readmission once healthz recovers")
+    finally:
+        lb.stop()
+        s1.stop()
+
+
+# ----------------------------------------------------------------------
+# Keep-alive transport (satellite: stale socket after a bounce)
+# ----------------------------------------------------------------------
+
+
+def test_keepalive_get_transparently_retries_after_bounce():
+    s1 = StubReplica()
+    conn = _ReplicaConn("127.0.0.1", s1.port, timeout=5.0)
+    try:
+        status, body, _ = conn.roundtrip("GET", "/gen", b"")
+        assert status == 200
+        # Bounce the replica: the kept-alive socket is now stale.
+        s1.stop()
+        s1.restart_same_port()
+        time.sleep(0.1)
+        status, body, _ = conn.roundtrip("GET", "/gen", b"")
+        assert status == 200, "stale keep-alive surfaced to the caller"
+        assert json.loads(body)["generation"] == "gen-000001"
+    finally:
+        conn.close()
+        s1.stop()
+
+
+def test_keepalive_bounce_through_balancer_no_client_error():
+    s1 = StubReplica()
+    lb = LoadBalancer([s1.url], port=0)
+    lb.start_background()
+    try:
+        code, _ = _post(lb.host, lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        assert code == 200
+        s1.stop()
+        s1.restart_same_port()
+        time.sleep(0.1)
+        # POST path: the send fails on the stale socket (pre-handler),
+        # reconnect-and-retry is safe and transparent.
+        code, _ = _post(lb.host, lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        assert code == 200
+    finally:
+        lb.stop()
+        s1.stop()
+
+
+def test_connection_refused_in_restart_window_retries_with_backoff():
+    s1 = StubReplica()
+    port = s1.port
+    lb = LoadBalancer([s1.url], port=0)
+    lb.start_background()
+    try:
+        code, _ = _post(lb.host, lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        assert code == 200
+        # Down for a moment inside a KNOWN restart window: the
+        # balancer retries the same slot with jittered backoff instead
+        # of answering 503.
+        s1.stop()
+        lb.set_restarting(0, True)
+
+        def come_back():
+            time.sleep(0.15)
+            s1.restart_same_port()
+
+        t = threading.Thread(target=come_back)
+        t.start()
+        code, _ = _post(lb.host, lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        t.join()
+        assert code == 200, "bounce inside restart window degraded"
+        assert lb.balancer_stats()["restart_retries_total"] >= 1
+    finally:
+        lb.stop()
+        s1.stop()
+
+
+# ----------------------------------------------------------------------
+# Rolling rollout
+# ----------------------------------------------------------------------
+
+
+def _coordinator(lb, pub, stubs, **kw):
+    kw.setdefault("poll_seconds", 0.05)
+    kw.setdefault("current", "gen-000001")
+    kw.setdefault("current_dir", os.path.join(pub, "gen-000001"))
+    kw.setdefault("step_timeout", 10.0)
+    kw.setdefault("drain_seconds", 0.05)
+    return RolloutCoordinator(lb, pub, **kw)
+
+
+def test_rolling_rollout_swaps_one_replica_at_a_time(tmp_path):
+    stubs = [StubReplica() for _ in range(3)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs)
+    try:
+        assert co.poll_once() is None  # current generation: no-op
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() == "gen-000002"
+        for s in stubs:
+            assert s.generation == "gen-000002"
+            assert len(s.reloads) == 1
+        # One at a time: reload windows never overlap.
+        windows = sorted(
+            (t0, t1) for s in stubs for (_, t0, t1) in s.reloads
+        )
+        for (a0, a1), (b0, b1) in zip(windows, windows[1:]):
+            assert a1 <= b0, "two replicas reloaded concurrently"
+        st = co.stats()
+        assert st["rollouts_completed_total"] == 1
+        assert st["rollout_steps_total"] == 3
+        assert st["generation"] == "gen-000002"
+        # No breaker is left held after the rollout.
+        assert all(b.eligible() for b in lb.breakers)
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_rollout_halts_when_replica_dies_and_resumes(tmp_path):
+    stubs = [StubReplica() for _ in range(3)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs)
+    try:
+        # Replica 1 is mid-restart when the pointer moves: its breaker
+        # is open (the supervisor's force_open) — a hot-swap arriving
+        # now must WAIT, not race the relaunch.
+        lb.breakers[1].force_open()
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["rollouts_halted_total"] == 1
+        # The old generation kept serving everywhere.
+        assert all(s.generation == "gen-000001" for s in stubs)
+        # Replica restarts and is readmitted -> the next poll retries
+        # the SAME pointer and completes.
+        lb.breakers[1].trial()
+        lb.breakers[1].record_success(probe=True)
+        lb.breakers[1].record_success(probe=True)
+        assert lb.breakers[1].eligible()
+        assert co.poll_once() == "gen-000002"
+        assert all(s.generation == "gen-000002" for s in stubs)
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_rollout_killed_mid_rollout_keeps_old_generation_on_rest(
+        tmp_path):
+    stubs = [StubReplica() for _ in range(3)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs)
+    # Kill replica at the SECOND step: after replica 0 swapped, stop
+    # replica 1's server AND open its breaker (what the supervisor
+    # does on waitpid) before the coordinator reaches it.
+    orig_swap = co._swap_replica
+
+    def swap_and_kill(i, gen, gen_dir, hold):
+        res = orig_swap(i, gen, gen_dir, hold)
+        if i == 0:
+            stubs[1].stop()
+            lb.breakers[1].force_open()
+        return res
+
+    co._swap_replica = swap_and_kill
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["rollouts_halted_total"] == 1
+        assert st["generation"] == "gen-000001"  # NOT promoted
+        # Replica 0 swapped before the kill; 2 was never touched — the
+        # old generation still serves there.
+        assert stubs[0].generation == "gen-000002"
+        assert stubs[2].generation == "gen-000001"
+        assert len(stubs[2].reloads) == 0
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_rollout_stage_failure_marks_generation_failed(tmp_path):
+    stubs = [StubReplica() for _ in range(2)]
+    stubs[0].reload_fail = True
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs)
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["generations_failed_total"] == 1
+        assert st["failed_generation"] == "gen-000002"
+        assert all(s.generation == "gen-000001" for s in stubs)
+        # NOT retried while the pointer stays.
+        assert co.poll_once() is None
+        assert co.stats()["rollouts_started_total"] == 1
+        # Pointer moves on -> the new generation is attempted.
+        stubs[0].reload_fail = False
+        _make_pub(tmp_path, "gen-000003")
+        assert co.poll_once() == "gen-000003"
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_rollout_transient_staging_503_halts_not_brands(tmp_path):
+    """A replica answering /reload 503 (transient storage trouble on
+    an existing dir) halts the rollout for a later retry — only a
+    staging REJECTION (4xx) brands the generation failed."""
+    stubs = [StubReplica() for _ in range(2)]
+    stubs[0].reload_transient = True
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs)
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["rollouts_halted_total"] == 1
+        assert st["generations_failed_total"] == 0
+        assert st["failed_generation"] is None
+        # The hiccup clears -> the SAME generation retries and lands.
+        stubs[0].reload_transient = False
+        assert co.poll_once() == "gen-000002"
+        assert all(s.generation == "gen-000002" for s in stubs)
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+# ----------------------------------------------------------------------
+# Shadow-canary promotion gate
+# ----------------------------------------------------------------------
+
+
+def _canary_cfg(**kw):
+    kw.setdefault("min_scores", 0)
+    kw.setdefault("mirror_seconds", 0.3)
+    kw.setdefault("agreement_gate", 0.6)
+    kw.setdefault("probes", [
+        {"path": "/synonyms", "body": {"word": "vienna", "num": 10}},
+        {"path": "/synonyms", "body": {"word": "berlin", "num": 10}},
+    ])
+    return CanaryConfig(**kw)
+
+
+def test_canary_holdback_on_regressed_generation(tmp_path):
+    answers = {
+        "gen-000001": ["vienna", "berlin", "paris"],
+        # The regressed candidate answers garbage.
+        "gen-000002": ["xx", "yy", "zz"],
+        # A later healthy candidate agrees with live.
+        "gen-000003": ["vienna", "berlin", "paris"],
+    }
+    stubs = [StubReplica(answers=answers) for _ in range(2)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs, canary=_canary_cfg())
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["canary"]["holdbacks_total"] == 1
+        assert st["canary"]["last_verdict"] == "held_back"
+        assert st["canary"]["last_agreement"] is not None
+        assert st["canary"]["last_agreement"] < 0.6
+        assert st["held_back_generation"] == "gen-000002"
+        # The candidate NEVER reached a non-canary replica, and the
+        # canary was restored to the live generation.
+        assert stubs[1].generation == "gen-000001"
+        assert len(stubs[1].reloads) == 0
+        assert stubs[0].generation == "gen-000001"
+        # Restored canary rejoined rotation.
+        assert all(b.eligible() for b in lb.breakers)
+        # Held back, not retried while the pointer stays.
+        assert co.poll_once() is None
+        assert co.stats()["canary"]["evaluations_total"] == 1
+        # A healthy next candidate passes and promotes fleet-wide.
+        _make_pub(tmp_path, "gen-000003")
+        assert co.poll_once() == "gen-000003"
+        assert all(s.generation == "gen-000003" for s in stubs)
+        st = co.stats()
+        assert st["canary"]["last_verdict"] == "pass"
+        assert st["canary"]["last_agreement"] == 1.0
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_canary_never_serves_live_traffic_while_held(tmp_path):
+    answers = {
+        "gen-000001": ["vienna", "berlin"],
+        "gen-000002": ["xx", "yy"],
+    }
+    stubs = [StubReplica(answers=answers) for _ in range(2)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    lb.start_background()
+    pub = _make_pub(tmp_path, "gen-000001")
+    cfg = _canary_cfg(min_scores=4, mirror_seconds=3.0, mirror_every=1)
+    co = _coordinator(lb, pub, stubs, canary=cfg)
+    stop = threading.Event()
+
+    def client_loop():
+        while not stop.is_set():
+            _post(lb.host, lb.port, "/synonyms",
+                  {"word": "vienna", "num": 5})
+            time.sleep(0.01)
+
+    t = threading.Thread(target=client_loop)
+    t.start()
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None  # held back
+        stop.set()
+        t.join()
+        # Every request the canary answered while it held the
+        # CANDIDATE generation was shadow traffic (scoring/mirror) —
+        # live traffic never saw gen-000002.
+        live_on_candidate = [
+            (w, g) for (w, g) in stubs[0].synonyms_live
+            if g == "gen-000002"
+        ]
+        assert live_on_candidate == [], live_on_candidate
+        assert any(
+            g == "gen-000002" for (_, g) in stubs[0].synonyms_shadow
+        ), "canary scored no shadow traffic"
+        # Mirrored scores were collected on top of the probes.
+        assert co.stats()["canary"]["last_scored"] >= 4
+    finally:
+        stop.set()
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_canary_degraded_below_pair_halts_instead_of_skipping(tmp_path):
+    """A canary-configured fleet degraded to one serving replica must
+    NOT roll an unvetted candidate onto it — the rollout waits for a
+    peer (halt + retry), preserving the gate's guarantee."""
+    stubs = [StubReplica() for _ in range(2)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs, canary=_canary_cfg(),
+                      replica_ok=lambda i: i != 1)  # replica 1 written off
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["rollouts_halted_total"] == 1
+        assert st["canary"]["evaluations_total"] == 0
+        assert all(s.generation == "gen-000001" for s in stubs)
+        assert len(stubs[0].reloads) == 0  # candidate never staged
+    finally:
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_canary_restores_before_release_when_warm_wait_fails(tmp_path):
+    """If the candidate is adopted but never proves healthy+warm, the
+    canary is reloaded back to the live generation BEFORE its hold is
+    released — the unvetted candidate never joins rotation."""
+    stubs = [StubReplica() for _ in range(2)]
+    lb = LoadBalancer([s.url for s in stubs], port=0)
+    pub = _make_pub(tmp_path, "gen-000001")
+    co = _coordinator(lb, pub, stubs, canary=_canary_cfg(),
+                      step_timeout=0.5)
+    # Warm-wait sees a replica that "adopted" the candidate but never
+    # reports healthy on it: freeze the stub's reported generation.
+    orig_wait = co._wait_replica_on
+    co._wait_replica_on = lambda i, gen, before=-1, *a, **k: (
+        "ok" if gen == "gen-000001"
+        else "not healthy on gen-000002 within 0s"
+    )
+    try:
+        _make_pub(tmp_path, "gen-000002")
+        assert co.poll_once() is None
+        st = co.stats()
+        assert st["rollouts_halted_total"] == 1
+        # The canary was restored to the live generation (a second
+        # reload) and released back into rotation.
+        assert stubs[0].generation == "gen-000001"
+        assert [g for g, _, _ in stubs[0].reloads] == [
+            "gen-000002", "gen-000001"
+        ]
+        assert lb.breakers[0].eligible()
+    finally:
+        co._wait_replica_on = orig_wait
+        co.stop()
+        lb.stop()
+        for s in stubs:
+            s.stop()
+
+
+# ----------------------------------------------------------------------
+# Fleet supervisor (subprocess stub replicas)
+# ----------------------------------------------------------------------
+
+_REPLICA_STUB = r"""
+import json, os, sys, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+port_file = sys.argv[1]
+gen = os.environ.get("GLINT_FLEET_GEN")
+crash_after = float(os.environ.get("STUB_CRASH_AFTER", "0"))
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            return self._send(200, {"status": "ok",
+                                    "fleet_generation": gen,
+                                    "post_warmup_compiles": 0})
+        if self.path == "/metrics":
+            return self._send(200, {
+                "endpoints": {},
+                "hot_swap": {"generation": None},
+                "compiles": {"post_warmup": 0},
+            })
+        self._send(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        if self.path == "/synonyms":
+            return self._send(200, [["w", 0.5]])
+        if self.path == "/shutdown":
+            self._send(200, {"status": "bye"})
+            threading.Thread(target=httpd.shutdown,
+                             daemon=True).start()
+            return
+        self._send(404, {})
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    json.dump({"host": "127.0.0.1",
+               "port": httpd.server_address[1],
+               "fleet_generation": gen}, f)
+os.replace(tmp, port_file)
+if crash_after:
+    def die():
+        import time
+        time.sleep(crash_after)
+        os._exit(3)
+    threading.Thread(target=die, daemon=True).start()
+httpd.serve_forever()
+"""
+
+
+@pytest.fixture()
+def stub_script(tmp_path):
+    path = tmp_path / "stub_replica.py"
+    path.write_text(_REPLICA_STUB)
+    return str(path)
+
+
+def _fast_supervisor(stub_script, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("port", 0)
+    kw.setdefault("poll_interval", 0.05)
+    kw.setdefault("backoff_base_seconds", 0.1)
+    kw.setdefault("backoff_cap_seconds", 0.5)
+    kw.setdefault("probe_interval", 0.05)
+    kw.setdefault("probe_timeout", 0.5)
+    kw.setdefault("breaker_failures", 2)
+    kw.setdefault("breaker_successes", 1)
+    kw.setdefault("breaker_open_seconds", 0.2)
+    kw.setdefault("ready_timeout", 30.0)
+    kw.setdefault("kill_grace_seconds", 1.0)
+    return FleetSupervisor(
+        None,
+        build_replica_argv=lambda i, pf: [
+            sys.executable, stub_script, pf
+        ],
+        **kw,
+    )
+
+
+def test_fleet_supervisor_restarts_dead_replica(stub_script):
+    sup = _fast_supervisor(stub_script, max_restarts=3)
+    runner = threading.Thread(target=sup.run, daemon=True)
+    runner.start()
+    try:
+        assert sup.ready.wait(30), "fleet never came up"
+        lb = sup.lb
+        code, _ = _post(lb.host, lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        assert code == 200
+        old_pid = sup._slots[0].proc.pid
+        os.kill(old_pid, 9)
+        # Detected, relaunched with backoff, fresh address adopted
+        # under the generation handshake, breaker readmitted.
+        _wait_for(
+            lambda: sup._slots[0].state == "up"
+            and sup._slots[0].restarts == 1
+            and sup._slots[0].proc.pid != old_pid,
+            timeout=20, msg="replica relaunch",
+        )
+        _wait_for(lambda: lb.breakers[0].state() == "closed",
+                  timeout=10, msg="relaunched replica readmitted")
+        # The whole exchange stays client-invisible.
+        for i in range(4):
+            code, _ = _post(lb.host, lb.port, "/synonyms",
+                            {"word": f"w{i}", "num": 2})
+            assert code == 200
+        doc = sup.report()
+        assert doc["supervisor"]["restarts_total"] == 1
+        assert doc["supervisor"]["replicas_failed"] == 0
+        recs = doc["supervisor"]["replica_states"][0]["restart_records"]
+        assert recs and recs[-1]["detect_to_ready_seconds"] is not None
+        # /metrics carries the supervisor block, lint-clean.
+        code, text = _get(lb.host, lb.port,
+                          "/metrics?format=prometheus")
+        text = text.decode()
+        lint_prometheus_text(text)
+        assert "glint_fleet_restarts_total 1" in text
+    finally:
+        sup.stop()
+        runner.join(timeout=15)
+        assert not runner.is_alive(), "supervisor loop hung"
+
+
+def test_fleet_supervisor_first_launch_env_not_rearmed(stub_script):
+    sup = _fast_supervisor(
+        stub_script, max_restarts=1,
+        # Replica 0 crashes itself shortly after its FIRST launch only
+        # (the chaos seam: the schedule must not be re-armed on the
+        # relaunch, or it would burn the whole budget).
+        replica_env_first_launch={0: {"STUB_CRASH_AFTER": "0.3"}},
+    )
+    runner = threading.Thread(target=sup.run, daemon=True)
+    runner.start()
+    try:
+        assert sup.ready.wait(30)
+        # First-launch-only crash env: the relaunch comes back healthy
+        # and the budget is NOT burned further (PR 7 rank0-env
+        # semantics on the serving tier).
+        _wait_for(
+            lambda: sup._slots[0].state == "up"
+            and sup._slots[0].restarts == 1,
+            timeout=20, msg="single restart after first-launch crash",
+        )
+        time.sleep(0.6)  # would crash again if env were re-armed
+        assert sup._slots[0].state == "up"
+        assert sup._slots[0].restarts == 1
+        code, _ = _post(sup.lb.host, sup.lb.port, "/synonyms",
+                        {"word": "w", "num": 2})
+        assert code == 200
+    finally:
+        sup.stop()
+        runner.join(timeout=15)
+
+
+# ----------------------------------------------------------------------
+# SnapshotWatcher transient-error backoff (satellite)
+# ----------------------------------------------------------------------
+
+
+class _WatchStubServer:
+    """Duck-typed stand-in for ModelServer: records reloads, owns a
+    real ServingMetrics."""
+
+    def __init__(self):
+        self.metrics = ServingMetrics()
+        self.reloads = []
+        self.fail_with = None
+
+    def reload_generation(self, gen_dir, generation=None):
+        if self.fail_with is not None:
+            raise self.fail_with
+        self.reloads.append(generation)
+        self.metrics.record_swap(generation, ok=True)
+
+
+def test_watcher_transient_pointer_error_backs_off_not_stalls(tmp_path):
+    from glint_word2vec_tpu.serving import SnapshotWatcher
+
+    pub = tmp_path / "pub"
+    pub.mkdir()
+    server = _WatchStubServer()
+    w = SnapshotWatcher(server, str(pub), poll_seconds=0.05)
+    # LATEST.json as a DIRECTORY: open() raises IsADirectoryError (an
+    # OSError — the transient-storage shape).
+    (pub / "LATEST.json").mkdir()
+    assert w.poll_once() is None
+    snap = server.metrics.snapshot()
+    assert snap["hot_swap"]["watch_errors_total"] == 1
+    assert w._failed is None  # nothing branded failed
+    # Inside the backoff window polls are free no-ops.
+    assert w.poll_once() is None
+    assert server.metrics.snapshot()["hot_swap"]["watch_errors_total"] == 1
+    # Error clears -> the next eligible poll swaps normally.
+    (pub / "LATEST.json").rmdir()
+    (pub / "gen-000007").mkdir()
+    tmp = pub / "LATEST.json.tmp"
+    tmp.write_text(json.dumps({"generation": "gen-000007"}))
+    os.replace(tmp, pub / "LATEST.json")
+    time.sleep(0.06)  # first-error backoff == poll_seconds
+    _wait_for(lambda: w.poll_once() == "gen-000007", timeout=5,
+              msg="post-error swap")
+    assert server.reloads == ["gen-000007"]
+    assert w.current == "gen-000007"
+
+
+def test_watcher_transient_staging_error_retries_same_generation(
+        tmp_path):
+    from glint_word2vec_tpu.serving import SnapshotWatcher
+
+    pub = tmp_path / "pub"
+    pub.mkdir()
+    (pub / "gen-000001").mkdir()
+    (pub / "LATEST.json").write_text(
+        json.dumps({"generation": "gen-000001"}))
+    server = _WatchStubServer()
+    server.fail_with = OSError("nfs hiccup")
+    w = SnapshotWatcher(server, str(pub), poll_seconds=0.05)
+    assert w.poll_once() is None
+    assert w._failed is None, "transient OSError branded the generation"
+    assert server.metrics.snapshot()["hot_swap"]["watch_errors_total"] == 1
+    server.fail_with = None
+    time.sleep(0.11)
+    assert w.poll_once() == "gen-000001"
+    # A non-OSError staging failure still brands the generation
+    # (corrupt candidate — the PR 10 contract unchanged).
+    server.fail_with = ValueError("manifest mismatch")
+    (pub / "gen-000002").mkdir()
+    tmp = pub / "LATEST.json.tmp"
+    tmp.write_text(json.dumps({"generation": "gen-000002"}))
+    os.replace(tmp, pub / "LATEST.json")
+    assert w.poll_once() is None
+    assert w._failed == "gen-000002"
+    assert server.metrics.snapshot()["hot_swap"]["swap_failures_total"] == 1
+    # SUSTAINED transient staging errors on one generation eventually
+    # brand it too (a permanently unreadable file is not a hiccup).
+    server.fail_with = OSError("shard deleted")
+    (pub / "gen-000003").mkdir()
+    tmp = pub / "LATEST.json.tmp"
+    tmp.write_text(json.dumps({"generation": "gen-000003"}))
+    os.replace(tmp, pub / "LATEST.json")
+    for _ in range(SnapshotWatcher.STAGING_ERROR_STRIKES):
+        w._retry_at = 0.0
+        w.poll_once()
+    assert w._failed == "gen-000003"
+    assert server.metrics.snapshot()["hot_swap"]["swap_failures_total"] == 2
+
+
+def test_watcher_backoff_caps_and_counts(tmp_path):
+    from glint_word2vec_tpu.serving import SnapshotWatcher
+
+    pub = tmp_path / "pub"
+    pub.mkdir()
+    (pub / "LATEST.json").mkdir()  # unreadable pointer
+    server = _WatchStubServer()
+    w = SnapshotWatcher(server, str(pub), poll_seconds=0.01)
+    for _ in range(6):
+        w.poll_once()
+        w._retry_at = 0.0  # collapse the wait, keep the doubling
+    errs = server.metrics.snapshot()["hot_swap"]["watch_errors_total"]
+    assert errs == 6
+    assert w._backoff <= SnapshotWatcher.BACKOFF_CAP

@@ -302,19 +302,31 @@ def test_watcher_never_loads_unreferenced_generation(publish_dir):
         # gen-000003 exists on disk, complete — but the pointer says 1.
         assert watcher.poll_once() is None
         assert server.metrics.table_swaps == 0
-        # A malformed pointer is ignored, not an error.
+        # A malformed pointer never swaps anything — since ISSUE 14 it
+        # is COUNTED as a transient watch error and backed off, not
+        # silently treated as "no publish yet".
         with open(os.path.join(pub, LATEST_NAME), "w") as f:
             f.write("{torn")
         assert watcher.poll_once() is None
         assert server.metrics.table_swaps == 0
+        assert server.metrics.watch_errors == 1
+        watcher._retry_at = 0.0  # collapse the backoff for the test
         _flip(pub, "gen-000002")
         assert watcher.poll_once() == "gen-000002"
         # A failed generation is not retried until the pointer moves:
-        # point at a missing dir, then back at a good one.
+        # point at a missing dir, then back at a good one. Since
+        # ISSUE 14 the first miss is treated as rename-visibility lag
+        # (a counted watch error + backoff); the dir still missing on
+        # the next look brands the generation failed.
         _flip(pub, "gen-777777")
         assert watcher.poll_once() is None
+        assert server.metrics.swap_failures == 0  # strike 1: transient
+        assert server.metrics.watch_errors == 2
+        watcher._retry_at = 0.0
         assert watcher.poll_once() is None
-        assert server.metrics.swap_failures == 1  # one failure, no retry
+        assert server.metrics.swap_failures == 1  # strike 2: branded
+        assert watcher.poll_once() is None
+        assert server.metrics.swap_failures == 1  # no retry
         _flip(pub, "gen-000003")
         assert watcher.poll_once() == "gen-000003"
     finally:
