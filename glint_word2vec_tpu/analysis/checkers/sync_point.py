@@ -154,6 +154,38 @@ SYNC_SEAMS: Dict[str, str] = {
     "EmbeddingEngine.ann_recall_at_k":
         "recall-gate seam: compares exact vs approximate host id sets "
         "at build/refresh time, off the request path",
+    # Replica-exchange seams (ISSUE 15): a reconciliation round IS a
+    # sync point by design — the harvest brings the fixed-capacity
+    # payload buffers to host for the cross-rank transport, and the
+    # protocol drivers shuffle host numpy throughout.
+    "glint_word2vec_tpu/parallel/exchange.py::ReplicaExchanger.harvest":
+        "exchange harvest seam: the padded (ids, deltas) buffers must "
+        "reach host for the cross-rank transport",
+    "glint_word2vec_tpu/parallel/exchange.py::"
+    "ReplicaExchanger._dense_delta":
+        "dense/spill harvest seam: the full per-rank delta is by "
+        "definition a host wire payload",
+    "glint_word2vec_tpu/parallel/exchange.py::ReplicaExchanger.sync":
+        "the exchange round itself: a deliberate reconciliation "
+        "barrier between dispatch groups (headers and payloads are "
+        "host numpy)",
+    "glint_word2vec_tpu/parallel/exchange.py::sync_group":
+        "in-process N-replica exchange driver (tests/harness): same "
+        "reconciliation barrier as ReplicaExchanger.sync",
+    "glint_word2vec_tpu/parallel/exchange.py::NullTransport.allgather":
+        "1-replica transport: wraps an already-host payload",
+    "glint_word2vec_tpu/parallel/exchange.py::"
+    "ProcessTransport.allgather":
+        "cross-process transport: process_allgather returns host "
+        "arrays by contract",
+    "glint_word2vec_tpu/parallel/distributed.py::allgather_host":
+        "host-level collective wire of the replica exchange: input and "
+        "output are host numpy by contract",
+    "glint_word2vec_tpu/parallel/engine.py::"
+    "EmbeddingEngine._iter_owned_block_producers":
+        "checkpoint harvest seam (shard-streaming form of "
+        "_iter_owned_blocks): each producer copies exactly one owned "
+        "block to host for the writer",
 }
 
 #: Expression roots that are host values by construction — calling

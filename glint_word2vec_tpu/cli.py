@@ -62,6 +62,18 @@ def _add_train(sub):
                         "dense pair batches on device) or the legacy "
                         "grid window batches (~43%% live lanes at "
                         "window 5)")
+    p.add_argument("--exchange", choices=["none", "sparse", "dense"],
+                   default="none",
+                   help="cross-replica reconciliation for multi-process "
+                        "runs (ISSUE 15): sparse ships only touched-row "
+                        "(ids, deltas) between data-parallel replicas "
+                        "after every dispatch group; dense ships full "
+                        "table deltas (parity baseline); none keeps the "
+                        "SPMD global-mesh path")
+    p.add_argument("--exchange-capacity", type=int, default=0,
+                   help="fixed touched-row buffer capacity per exchange "
+                        "sync (0 = auto-sized from the dispatch-group "
+                        "pair budget)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--checkpoint-every", type=int, default=1,
@@ -916,6 +928,8 @@ def _run(args) -> int:
             steps_per_call=args.steps_per_call,
             shared_negatives=args.shared_negatives,
             batch_packing=args.packing,
+            exchange=args.exchange,
+            exchange_capacity=args.exchange_capacity,
         )
         obs = None
         if (args.status_port is not None or args.status_file

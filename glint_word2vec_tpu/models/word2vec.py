@@ -131,6 +131,25 @@ def _resolve_resume(checkpoint_dir: str) -> Optional[dict]:
     return state
 
 
+def _process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _multiprocess_barrier(tag: str) -> None:
+    """All-process rendezvous (replica-exchange checkpoints, ISSUE 15):
+    a rank must not flip ``train_state.json`` while peers are still
+    writing their shard blocks — the flip would commit a snapshot whose
+    per-shard manifests don't all exist yet. No-op single-process."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_" + tag)
+
+
 def _ckpt_wait_timeout() -> Optional[float]:
     """Fit-exit barrier timeout for in-flight async checkpoint writes:
     a writer thread wedged on a dead filesystem must fail the run with
@@ -171,6 +190,11 @@ def _checkpoint_tables(
     else:
         with obs_run.span("checkpoint_save", ckpt=ck_name):
             engine.save(ck_path)
+            # Multi-process saves write disjoint shard files; the
+            # barrier orders every rank's writes (and sidecar
+            # manifests) before ANY rank's state flip makes the
+            # snapshot authoritative.
+            _multiprocess_barrier(ck_name)
             commit()
     metrics.record_stall(time.time() - t0)
 
@@ -293,6 +317,19 @@ class Word2Vec:
         See README "Dense pair packing"."""
         return self._set(batch_packing=v)
 
+    def set_exchange(self, v: str) -> "Word2Vec":
+        """Cross-replica reconciliation mode for multi-process runs
+        (ISSUE 15): "none" = SPMD global mesh, "sparse" = touched-row
+        delta exchange between data-parallel replicas, "dense" = full
+        delta exchange on the same cadence (parity baseline). See
+        README "Pod-scale training"."""
+        return self._set(exchange=v)
+
+    def set_exchange_capacity(self, v: int) -> "Word2Vec":
+        """Fixed touched-row buffer capacity per exchange sync (0 =
+        auto-sized from the dispatch-group pair budget)."""
+        return self._set(exchange_capacity=v)
+
     def set_observability(self, obs) -> "Word2Vec":
         """Attach an :class:`obs.ObsConfig` for subsequent fits (event
         log, live heartbeat, status file, divergence canary)."""
@@ -301,12 +338,22 @@ class Word2Vec:
 
     # ------------------------------------------------------------------
 
-    def _make_mesh(self):
+    def _make_mesh(self, local: bool = False):
         from glint_word2vec_tpu.parallel.mesh import make_mesh
 
         if self.mesh is not None:
             return self.mesh
         p = self.params
+        if local:
+            # Replica-exchange mode (ISSUE 15): each process owns a
+            # mesh over ITS devices only — cross-process traffic is the
+            # host-level delta exchange, never an SPMD collective.
+            import jax
+
+            return make_mesh(
+                p.num_partitions, p.num_shards,
+                devices=jax.local_devices(),
+            )
         return make_mesh(p.num_partitions, p.num_shards)
 
     def fit(
@@ -353,6 +400,17 @@ class Word2Vec:
             encode_sentences(sentences, vocab), p.max_sentence_length
         )
         lens = np.array([s.size for s in encoded], dtype=np.int64)
+        if p.exchange != "none" and _process_count() > 1:
+            ids = (
+                np.concatenate(encoded).astype(np.int32, copy=False)
+                if encoded else np.zeros(0, np.int32)
+            )
+            offsets = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            return self._fit_replica_exchange(
+                vocab, ids, offsets, checkpoint_dir,
+                checkpoint_every_epochs, stop_after_epochs,
+            )
         pc, local_batch, steps_per_epoch = self._multihost_plan(lens)
         if pc == 1 and self._device_corpus_eligible(int(lens.sum())):
             # encode_sentences already yields int32; copy=False avoids a
@@ -449,6 +507,11 @@ class Word2Vec:
         device-resident scan when eligible, else shard across processes
         and run the host batcher pipeline."""
         p = self.params
+        if p.exchange != "none" and _process_count() > 1:
+            return self._fit_replica_exchange(
+                vocab, ids, offsets, checkpoint_dir,
+                checkpoint_every_epochs, stop_after_epochs,
+            )
         pc, local_batch, steps_per_epoch = self._multihost_plan(np.diff(offsets))
         if pc == 1 and self._device_corpus_eligible(int(ids.size)):
             return self._fit_corpus_resident(
@@ -469,6 +532,40 @@ class Word2Vec:
         return self._fit_with_batcher(
             vocab, batcher, checkpoint_dir, checkpoint_every_epochs,
             stop_after_epochs, steps_per_epoch=steps_per_epoch,
+        )
+
+    def _fit_replica_exchange(
+        self,
+        vocab: Vocabulary,
+        ids: np.ndarray,
+        offsets: np.ndarray,
+        checkpoint_dir: Optional[str],
+        checkpoint_every_epochs: int,
+        stop_after_epochs: Optional[int],
+    ) -> "Word2VecModel":
+        """Multi-process replica-exchange fit (ISSUE 15): every process
+        takes its round-robin corpus shard, trains it on a LOCAL mesh,
+        and reconciles tables with its peers through the touched-row
+        delta exchange after every dispatch group
+        (parallel/exchange.py) — no SPMD collective ever crosses
+        processes, so cross-host bytes scale with rows touched instead
+        of vocab size. Identical engine seeds give every replica the
+        same initial tables; each sync leaves all replicas
+        value-identical again."""
+        from glint_word2vec_tpu.parallel import distributed as dist
+
+        ids, offsets = dist.shard_flat_for_process(ids, offsets)
+        # graftlint: ignore[sync-point] ids is host numpy here
+        if not self._device_corpus_eligible(int(ids.size)):
+            raise ValueError(
+                "replica-exchange training needs the device-resident "
+                "corpus path: this process's corpus shard exceeds the "
+                "device corpus budget (GLINT_DEVICE_CORPUS_MAX_BYTES) "
+                "or GLINT_HOST_BATCHER=1 is set"
+            )
+        return self._fit_corpus_resident(
+            vocab, ids, offsets, checkpoint_dir,
+            checkpoint_every_epochs, stop_after_epochs,
         )
 
     def _device_corpus_eligible(self, corpus_words: int = 0) -> bool:
@@ -539,7 +636,8 @@ class Word2Vec:
             corpus_words_done_compacted,
         )
 
-        mesh = self._make_mesh()
+        replica_mode = p.exchange != "none" and jax.process_count() > 1
+        mesh = self._make_mesh(local=replica_mode)
         if p.batch_size % mesh.shape["data"]:
             raise ValueError(
                 f"batch_size ({p.batch_size}) must be divisible by the "
@@ -648,6 +746,51 @@ class Word2Vec:
                 )
             )
             obs_run.attach_metrics(metrics)
+            # Replica exchange (ISSUE 15): constructed AFTER any resume
+            # restore so the reconciliation base snapshots the restored
+            # tables. Per-rank key decorrelation folds the process rank
+            # into the step-key stream (table INIT stays seed-identical
+            # across replicas — reconciliation depends on it); the save
+            # split makes every rank checkpoint only its own row block.
+            exchanger = None
+            if p.exchange != "none":
+                from glint_word2vec_tpu.parallel import exchange as exmod
+
+                transport = (
+                    exmod.ProcessTransport()
+                    if jax.process_count() > 1 else exmod.NullTransport()
+                )
+                if transport.world > 1:
+                    if stop_after_groups is not None:
+                        # The stop-early drill breaks the lockstep
+                        # protocol mid-epoch: peers would wait in the
+                        # exchange collective forever. Fail loudly
+                        # instead of deadlocking the gang.
+                        raise ValueError(
+                            "GLINT_PACKED_STOP_AFTER_GROUPS is not "
+                            "supported with multi-process replica "
+                            "exchange (peers would deadlock in the "
+                            "exchange collective)"
+                        )
+                    engine.set_save_split(transport.rank, transport.world)
+                    base_key = jax.random.fold_in(
+                        base_key, transport.rank
+                    )
+                else:
+                    logger.info(
+                        "replica exchange on a single process: the "
+                        "reconciliation protocol runs for parity/"
+                        "telemetry (one extra table pair of HBM, one "
+                        "sync per dispatch group) with no cross-rank "
+                        "traffic"
+                    )
+                exchanger = exmod.ReplicaExchanger(
+                    engine, mode=p.exchange,
+                    capacity=p.exchange_capacity or None,
+                    transport=transport,
+                    pair_batch=pair_batch if packed else B,
+                    steps_per_call=spc,
+                )
             # Mutated by _harvest_packed (declared before the epoch loop
             # so the closure binds the method scope, not a loop body).
             n_pos, offsets_c, epoch, epoch_wd = N, None, start_epoch, 0
@@ -772,6 +915,15 @@ class Word2Vec:
                         stop_after_groups is None
                         and os.environ.get("GLINT_SYNC_READBACK", "0")
                         != "1"
+                        # Exchange rounds are reconciliation barriers:
+                        # every group ends with a host-level sync, so
+                        # the one-group-deferred schedule has nothing
+                        # to overlap.
+                        and exchanger is None
+                    )
+                    gang_live = (
+                        exchanger is not None
+                        and exchanger.transport.world > 1
                     )
                     pending = None
                     next_start = pos  # host int now, device scalar later
@@ -805,6 +957,13 @@ class Word2Vec:
                             pos = _harvest_packed(pending)
                             pending = None
                             next_start = pos
+                            if exchanger is not None:
+                                with metrics.timing("step"), obs_run.span(
+                                    "exchange_sync", packed=True
+                                ):
+                                    gang_live = exchanger.sync(
+                                        live=True, done=pos >= n_pos
+                                    )
                             if (
                                 stop_after_groups is not None
                                 and packed_groups >= stop_after_groups
@@ -819,6 +978,18 @@ class Word2Vec:
                     if pending is not None:
                         pos = _harvest_packed(pending)
                         pending = None
+                    # Lockstep fillers (replica exchange): a drained
+                    # rank keeps answering the gang's exchange rounds
+                    # with empty payloads until EVERY rank reports done
+                    # — no peer is ever left waiting in a collective.
+                    if exchanger is not None and not early_stop:
+                        while gang_live:
+                            with metrics.timing("step"), obs_run.span(
+                                "exchange_sync", filler=True
+                            ):
+                                gang_live = exchanger.sync(
+                                    live=False, done=True
+                                )
                     # Drop the phantom tail group's keys (if any) so the
                     # next epoch's step0 matches the synchronous loop.
                     dstep = step
@@ -850,6 +1021,10 @@ class Word2Vec:
                     # (spc keys per group, tail no-ops included).
                     gstep += groups * spc
                 else:
+                    gang_live = (
+                        exchanger is not None
+                        and exchanger.transport.world > 1
+                    )
                     for g in range(groups):
                         faults.fire("worker.step")
                         start_pos = g * spc * B
@@ -911,6 +1086,22 @@ class Word2Vec:
                                 alpha=float(alphas[n_real - 1]),
                             )
                         step += spc - n_real  # tail no-ops consumed keys
+                        if exchanger is not None:
+                            with metrics.timing("step"), obs_run.span(
+                                "exchange_sync"
+                            ):
+                                gang_live = exchanger.sync(
+                                    live=True, done=(g == groups - 1)
+                                )
+                    if exchanger is not None:
+                        # Lockstep fillers: see the packed branch.
+                        while gang_live:
+                            with metrics.timing("step"), obs_run.span(
+                                "exchange_sync", filler=True
+                            ):
+                                gang_live = exchanger.sync(
+                                    live=False, done=True
+                                )
                     gstep = step
                     # Grid dispatches are asynchronous: the tail group is
                     # still executing here, so the next epoch's
@@ -979,6 +1170,9 @@ class Word2Vec:
         if steptime:
             model.training_metrics["steptime"] = steptime
         model.training_metrics["batch_packing"] = p.batch_packing
+        if exchanger is not None:
+            model.training_metrics["exchange_mode"] = p.exchange
+            model.training_metrics["exchange"] = engine.exchange_stats()
         if packed and packed_slots:
             # Packed fill = live pairs / dispatched pair slots — the
             # effective mask density of the packed dispatches (the grid

@@ -52,6 +52,12 @@ _SUM_COUNTERS = (
     ("words_done_total", "words_done"),
     ("query_compiles_total", "query_compiles"),
     ("async_save_waits_total", "async_save_waits"),
+    # Replica-exchange + shard-checkpoint rollups (ISSUE 15): the gang
+    # totals are the pod's bytes-on-wire / touched-row / spill budget.
+    ("exchange_bytes_total", "exchange_bytes_total"),
+    ("exchange_rows_total", "exchange_rows_total"),
+    ("exchange_overflow_total", "exchange_overflow_total"),
+    ("checkpoint_shards_skipped_total", "checkpoint_shards_skipped"),
 )
 
 #: Rank states that make the whole gang unhealthy on /healthz.
@@ -93,6 +99,11 @@ def merge_training_snapshots(
     counters["canary_trips_total"] = 0
     counters["events_recorded_total"] = 0
     counters["events_dropped_total"] = 0
+    # Shard-checkpoint seconds fold to the SLOWEST rank (the actionable
+    # fleet number, same policy as the serving aggregate's checkpoint
+    # block); None until any rank reports them.
+    shard_write_max = None
+    shard_verify_max = None
     per_rank: Dict[str, dict] = {}
     wps_total = 0.0
     step_means: List[float] = []
@@ -111,6 +122,12 @@ def merge_training_snapshots(
         ev = snap.get("events") or {}
         counters["events_recorded_total"] += int(ev.get("recorded") or 0)
         counters["events_dropped_total"] += int(ev.get("dropped") or 0)
+        v = snap.get("checkpoint_shard_write_seconds")
+        if v is not None:
+            shard_write_max = max(shard_write_max or 0.0, v)
+        v = snap.get("checkpoint_shard_verify_seconds")
+        if v is not None:
+            shard_verify_max = max(shard_verify_max or 0.0, v)
         wps = float(snap.get("words_per_sec_rolling") or 0.0)
         wps_total += wps
         ms = _mean_step_seconds(snap)
@@ -190,6 +207,8 @@ def merge_training_snapshots(
         "counters": counters,
         "words_per_sec_total": round(wps_total, 1),
         "rank_skew": rank_skew,
+        "checkpoint_shard_write_seconds_max": shard_write_max,
+        "checkpoint_shard_verify_seconds_max": shard_verify_max,
         "per_rank": per_rank,
         "steptime": steptime,
     }

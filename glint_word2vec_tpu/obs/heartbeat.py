@@ -266,6 +266,37 @@ class TrainingStatus:
                 snap["last_checkpoint_age_seconds"] = _finite_or_none(
                     ck.get("last_checkpoint_age_seconds")
                 )
+                # Shard-streaming checkpoint telemetry (ISSUE 15).
+                snap["checkpoint_shard_write_seconds"] = _finite_or_none(
+                    ck.get("checkpoint_shard_write_seconds")
+                )
+                snap["checkpoint_shard_verify_seconds"] = _finite_or_none(
+                    ck.get("checkpoint_shard_verify_seconds")
+                )
+                snap["checkpoint_shards_skipped"] = ck.get(
+                    "checkpoint_shards_skipped", 0
+                )
+            ex_stats = getattr(eng, "exchange_stats", None)
+            if ex_stats is not None:
+                # Touched-row replica-exchange counters (ISSUE 15):
+                # zeros on non-exchange fits, summed into the gang
+                # rollup by obs.aggregate.
+                try:
+                    exs = ex_stats()
+                except Exception:
+                    exs = {}
+                snap["exchange_bytes_total"] = exs.get(
+                    "exchange_bytes_total", 0
+                )
+                snap["exchange_rows_total"] = exs.get(
+                    "exchange_rows_total", 0
+                )
+                snap["exchange_overflow_total"] = exs.get(
+                    "exchange_overflow_total", 0
+                )
+                snap["exchange_syncs_total"] = exs.get(
+                    "exchange_syncs_total", 0
+                )
         if rec is not None:
             snap["events"] = rec.counts()
         if ledger is not None:

@@ -93,6 +93,15 @@ def training_to_prometheus(snap: dict) -> str:
         ("glint_training_last_checkpoint_age_seconds",
          "last_checkpoint_age_seconds",
          "Seconds since the last committed checkpoint (NaN before any)."),
+        ("glint_training_checkpoint_shard_write_seconds",
+         "checkpoint_shard_write_seconds",
+         "Seconds writing+hashing table shard blocks in the most "
+         "recent checkpoint save (shard-streaming path, NaN before "
+         "any)."),
+        ("glint_training_checkpoint_shard_verify_seconds",
+         "checkpoint_shard_verify_seconds",
+         "Seconds verifying per-shard manifests in the most recent "
+         "checkpoint stage/restore (NaN before any)."),
         ("glint_training_uptime_seconds", "uptime_seconds",
          "Seconds since the fit's observability run started."),
         ("glint_training_table_version", "table_version",
@@ -118,6 +127,22 @@ def training_to_prometheus(snap: dict) -> str:
         ("glint_training_async_save_waits_total", "async_save_waits",
          "Checkpoint requests that blocked on a still-in-flight "
          "snapshot (checkpoint back-pressure)."),
+        ("glint_training_exchange_bytes_total", "exchange_bytes_total",
+         "Replica-exchange bytes this rank shipped (headers + padded "
+         "id/delta buffers, or full deltas on dense/spill rounds)."),
+        ("glint_training_exchange_rows_total", "exchange_rows_total",
+         "Touched table rows this rank harvested into exchange "
+         "payloads (pre-padding, both tables)."),
+        ("glint_training_exchange_overflow_total",
+         "exchange_overflow_total",
+         "Exchange rounds whose touched rows overflowed the capacity "
+         "buffer and spilled to the dense path."),
+        ("glint_training_exchange_syncs_total", "exchange_syncs_total",
+         "Replica-exchange reconciliation rounds completed."),
+        ("glint_training_checkpoint_shards_skipped_total",
+         "checkpoint_shards_skipped",
+         "In-place checkpoint shard writes skipped because the shard "
+         "was clean since the last committed save."),
     ]
     for name, key, help_ in counters:
         p.head(name, "counter", help_)
@@ -232,6 +257,14 @@ def gang_to_prometheus(snap: dict) -> str:
         ("glint_gang_rank_skew", "rank_skew",
          "Straggler skew: max/median of per-rank mean step seconds "
          "(1.0 = balanced; NaN until ranks report step timing)."),
+        ("glint_gang_checkpoint_shard_write_seconds",
+         "checkpoint_shard_write_seconds_max",
+         "Slowest rank's shard-block write seconds in the most recent "
+         "checkpoint save (NaN before any)."),
+        ("glint_gang_checkpoint_shard_verify_seconds",
+         "checkpoint_shard_verify_seconds_max",
+         "Slowest rank's per-shard manifest verify seconds in the most "
+         "recent restore/stage (NaN before any)."),
     ]
     for name, key, help_ in gauges:
         p.head(name, "gauge", help_)
@@ -249,6 +282,15 @@ def gang_to_prometheus(snap: dict) -> str:
          "Engine query-shape compiles summed over ranks."),
         ("glint_gang_async_save_waits_total", "async_save_waits_total",
          "Checkpoint back-pressure waits summed over ranks."),
+        ("glint_gang_exchange_bytes_total", "exchange_bytes_total",
+         "Replica-exchange bytes on the wire summed over ranks."),
+        ("glint_gang_exchange_rows_total", "exchange_rows_total",
+         "Touched rows shipped through the exchange summed over ranks."),
+        ("glint_gang_exchange_overflow_total", "exchange_overflow_total",
+         "Capacity-overflow dense spills summed over ranks."),
+        ("glint_gang_checkpoint_shards_skipped_total",
+         "checkpoint_shards_skipped_total",
+         "Clean checkpoint shards skipped in-place summed over ranks."),
         ("glint_gang_canary_trips_total", "canary_trips_total",
          "Divergence-canary trips summed over ranks."),
         ("glint_gang_events_recorded_total", "events_recorded_total",

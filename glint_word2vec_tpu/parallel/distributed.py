@@ -240,6 +240,25 @@ def shard_flat_for_process(
     return out_ids, out_offsets
 
 
+def allgather_host(arr: np.ndarray) -> np.ndarray:
+    """Host-level allgather of one fixed-shape numpy array: returns
+    ``(process_count, *shape)`` with rank order preserved. The wire of
+    the replica-exchange protocol (parallel/exchange.py): gloo between
+    CPU gang processes, DCN across pod hosts, via
+    ``multihost_utils.process_allgather`` — each distinct buffer shape
+    compiles exactly one collective, so the exchange's fixed-capacity
+    padded buffers keep this compile-once. Single-process returns
+    ``arr[None]`` without touching the collective machinery."""
+    import jax
+
+    a = np.asarray(arr)
+    if jax.process_count() == 1:
+        return a[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(a))
+
+
 def per_process_word_counts(
     sentence_lengths: np.ndarray, process_count: int
 ) -> np.ndarray:

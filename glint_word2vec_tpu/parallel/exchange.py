@@ -1,0 +1,457 @@
+"""Sparse touched-row delta exchange between data-parallel replicas.
+
+ROADMAP item 4 (pod-scale training): the SPMD mesh path ships batch
+payloads inside every jitted step, which is right for chips on one ICI
+fabric — but across hosts on DCN (or across gang processes on gloo) the
+win is to let each replica train privately on its own corpus shard and
+reconcile on a cadence. SGNS touches O(batch * (1 + C + n)) rows per
+step out of V, so reconciliation that ships whole tables (the classic
+dense allreduce) pays O(V * d) per sync regardless of how little a
+dispatch group actually trained. Following Ji et al. (arXiv:1604.04661)
+and the partitioned-embedding work (arXiv:1909.03359), this module makes
+the wire cost proportional to *touched rows* instead:
+
+  * each replica snapshots its tables at group start (a jitted
+    device-side copy — the train scans donate the live buffers, so the
+    base costs one extra table pair of HBM, halved by bf16 storage);
+  * after the dispatch group, a jitted harvest diffs current vs base,
+    dedupes touched rows BY CONSTRUCTION (one row = one delta, the
+    table-diff restatement of the sorted-run-sum dedupe in
+    ``engine._dup_sum_f32``), and compacts their ids into a
+    FIXED-CAPACITY padded buffer via the same prefix-sum scatter trick
+    as ``ops/device_batching.subsample_compact`` — every traced shape is
+    constant, so the whole protocol compiles once and stays
+    ``fit_stream``-compatible;
+  * replicas allgather a tiny header, then the padded (ids, deltas)
+    buffers — ``capacity * (4 + 4d)`` bytes per table instead of
+    ``V * d * 4``;
+  * every replica reconstructs ``base + delta_0 + delta_1 + ...`` in
+    rank order, so all replicas leave the sync with value-identical
+    tables, and the sparse schedule reproduces the dense schedule's
+    tables exactly (the parity gates in tests/test_exchange.py).
+
+Overflow spill: a group that touches more rows than ``capacity`` raises
+the header's overflow flag and THAT round falls back to shipping the
+dense per-rank delta (correctness never depends on the capacity guess);
+``exchange_overflow_total`` counts the spills so operators can size
+capacity from telemetry. ``GLINT_DENSE_EXCHANGE=1`` forces the dense
+path outright (the escape hatch and the parity baseline).
+
+Transports: :class:`ProcessTransport` rides
+``jax.experimental.multihost_utils.process_allgather`` (gloo on CPU
+gangs, DCN on pods); :class:`NullTransport` is the 1-replica degenerate
+case; :func:`sync_group` drives N in-process engines through the same
+decide/apply helpers (the weak-scaling harness and the parity tests).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from glint_word2vec_tpu.utils import faults, next_pow2
+
+#: Wire dtype of delta payloads (accumulation dtype, not storage dtype:
+#: deltas of bf16 tables still travel and sum in fp32 so the
+#: reconstruction rounds each row total once — same contract as
+#: ``engine._bf16_safe_scatter_add``).
+_WIRE_DTYPE = np.float32
+
+#: Header layout (int64): [live, done, n0, ovf0, n1, ovf1].
+HEADER_LEN = 6
+
+
+def default_capacity(engine, pair_batch: int, steps_per_call: int) -> int:
+    """Capacity heuristic: bound the rows one dispatch group can touch
+    — ``steps_per_call * pair_batch`` pairs, each touching one center,
+    one context, and ``num_negatives`` noise rows — rounded up to a
+    power of two and clamped to the table. Dedup makes the true count
+    far smaller on zipfian corpora; overflow spills keep a bad guess
+    safe, not wrong. ``GLINT_EXCHANGE_CAPACITY`` overrides."""
+    env = os.environ.get("GLINT_EXCHANGE_CAPACITY")
+    if env:
+        return max(1, min(int(env), engine.num_rows))
+    touched = pair_batch * steps_per_call * (2 + engine.num_negatives)
+    return min(next_pow2(max(256, touched)), engine.num_rows)
+
+
+def _build_harvest_fn(engine, capacity: int):
+    """Jitted (cur0, cur1, base0, base1) -> per-table
+    ``(ids, deltas, n, overflow)`` harvest for one replica. Touched =
+    any component of the fp32 delta is nonzero; ids compact into the
+    ``capacity`` buffer by prefix-sum scatter (slot ``capacity`` is the
+    shared dump slot for overflow/untouched writes)."""
+    import jax
+    import jax.numpy as jnp
+
+    cap = int(capacity)  # graftlint: ignore[sync-point] host config scalar
+    num_rows = engine.num_rows
+    dim = engine.dim
+
+    def one(cur, base):
+        delta = cur.astype(jnp.float32) - base.astype(jnp.float32)
+        rows = jnp.arange(delta.shape[0], dtype=jnp.int32)
+        touched = jnp.any(delta != 0.0, axis=1) & (rows < num_rows)
+        n = touched.sum().astype(jnp.int32)
+        pos = jnp.cumsum(touched.astype(jnp.int32)) - 1
+        slot = jnp.where(touched & (pos < cap), pos, cap)
+        ids = jnp.zeros(cap + 1, jnp.int32).at[slot].set(rows)[:cap]
+        valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(n, cap)
+        ids = jnp.where(valid, ids, 0)
+        deltas = jnp.where(valid[:, None], delta[ids, :dim], 0.0)
+        return ids, deltas, n, n > cap
+
+    def harvest(cur0, cur1, base0, base1):
+        return one(cur0, base0), one(cur1, base1)
+
+    return jax.jit(harvest)
+
+
+def _build_dense_fn(engine):
+    """Jitted (cur, base) -> fp32 delta sliced to the real
+    (num_rows, dim) extent — the spill/dense-mode payload."""
+    import jax
+    import jax.numpy as jnp
+
+    num_rows, dim = engine.num_rows, engine.dim
+
+    def dense(cur, base):
+        d = cur.astype(jnp.float32) - base.astype(jnp.float32)
+        return d[:num_rows, :dim]
+
+    return jax.jit(dense)
+
+
+def _build_apply_sparse_fn(engine, capacity: int, world: int):
+    """Jitted reconstruction ``base + sum_r delta_r`` from R stacked
+    sparse payloads, applied rank by rank (ids unique within a rank, so
+    every scatter is deterministic and each replica computes the
+    identical float sum in the identical order)."""
+    import jax
+    import jax.numpy as jnp
+
+    dim = engine.dim
+    tsh = engine._table_sharding()
+
+    def one(base, ids_r, deltas_r):
+        acc = base.astype(jnp.float32)
+        for r in range(world):
+            upd = jnp.zeros(
+                (capacity, base.shape[1]), jnp.float32
+            ).at[:, :dim].set(deltas_r[r])
+            acc = acc.at[ids_r[r]].add(upd)
+        return acc.astype(base.dtype)
+
+    def apply(base0, base1, ids0, d0, ids1, d1):
+        return one(base0, ids0, d0), one(base1, ids1, d1)
+
+    return jax.jit(apply, out_shardings=(tsh, tsh))
+
+
+def _build_snapshot_fn(engine):
+    """Jitted device-side table copy for the reconciliation base. A
+    bare reference is NOT a snapshot here: the train scans donate the
+    table buffers, so the pre-group arrays would be freed by the first
+    dispatch. One extra table pair of HBM while an exchange group is in
+    flight (bf16 storage halves it)."""
+    import jax
+    import jax.numpy as jnp
+
+    tsh = engine._table_sharding()
+
+    def snap(a, b):
+        return jnp.copy(a), jnp.copy(b)
+
+    return jax.jit(snap, out_shardings=(tsh, tsh))
+
+
+def _build_apply_dense_fn(engine, world: int):
+    """Dense twin of the sparse apply: sequential per-rank full-delta
+    adds in rank order — per-row float schedule identical to the sparse
+    scatter path (an untouched rank contributes exact +0.0)."""
+    import jax
+    import jax.numpy as jnp
+
+    num_rows, dim = engine.num_rows, engine.dim
+    tsh = engine._table_sharding()
+
+    def one(base, deltas_r):
+        acc = base.astype(jnp.float32)
+        for r in range(world):
+            pad = jnp.zeros(base.shape, jnp.float32)
+            pad = pad.at[:num_rows, :dim].set(deltas_r[r])
+            acc = acc + pad
+        return acc.astype(base.dtype)
+
+    def apply(base0, base1, d0, d1):
+        return one(base0, d0), one(base1, d1)
+
+    return jax.jit(apply, out_shardings=(tsh, tsh))
+
+
+class NullTransport:
+    """1-replica transport: allgather returns the local payload alone.
+    Keeps the exchange protocol exercisable (and its telemetry live) in
+    single-process fits and unit tests."""
+
+    rank = 0
+    world = 1
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        return np.asarray(arr)[None]
+
+
+class ProcessTransport:
+    """Cross-process transport over the JAX distributed runtime
+    (``distributed.allgather_host``): gloo between CPU gang processes,
+    DCN across pod hosts. Every payload shape is fixed by construction,
+    so each distinct buffer compiles one collective."""
+
+    def __init__(self):
+        import jax
+
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        from glint_word2vec_tpu.parallel.distributed import (
+            allgather_host,
+        )
+
+        return allgather_host(arr)
+
+
+class ReplicaExchanger:
+    """Drives the touched-row delta exchange for ONE replica engine.
+
+    Lifecycle: ``begin()`` snapshots the table refs; the fit loop runs
+    one dispatch group; ``sync(live=..., done=...)`` harvests, swaps
+    deltas with the peer replicas through ``transport``, reconstructs
+    the reconciled tables on every replica, and re-snapshots. Returns
+    True while any replica still has work (the lockstep loop condition:
+    a drained replica keeps calling ``sync(live=False)`` with empty
+    payloads until the whole gang reports done, so no collective is
+    ever left waiting).
+    """
+
+    def __init__(self, engine, *, mode: str = "sparse",
+                 capacity: Optional[int] = None,
+                 transport=None, pair_batch: int = 1024,
+                 steps_per_call: int = 16):
+        if mode not in ("sparse", "dense"):
+            raise ValueError("exchange mode must be 'sparse' or 'dense'")
+        self.engine = engine
+        self.transport = transport if transport is not None else NullTransport()
+        if os.environ.get("GLINT_DENSE_EXCHANGE", "0") == "1":
+            mode = "dense"  # operator escape hatch
+        self.mode = mode
+        # graftlint: ignore[sync-point] host config scalar
+        self.capacity = int(
+            capacity if capacity
+            else default_capacity(engine, pair_batch, steps_per_call)
+        )
+        self._fns = {}
+        self._base = None
+        # Snapshot NOW: the base must predate the first dispatch group,
+        # or that group's deltas silently vanish from the exchange.
+        self.begin()
+
+    # -- device programs (compiled once per engine/capacity) -----------
+
+    def _fn(self, kind: str, builder, *args):
+        key = (kind, *args)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = builder(self.engine, *args)
+        return fn
+
+    def begin(self) -> None:
+        """Snapshot the reconciliation base: a jitted device-side copy
+        of both tables (the train scans donate the live buffers, so a
+        reference would dangle after the first dispatch)."""
+        fn = self._fn("snapshot", _build_snapshot_fn)
+        self._base = fn(self.engine.syn0, self.engine.syn1)
+
+    def harvest(self):
+        """Run the jitted touched-row harvest for this replica and
+        bring the fixed-capacity buffers to host (the one device->host
+        sync of the exchange; the transport needs host arrays).
+        Returns ``(header_body, payload)`` where payload is
+        ``(ids0, d0, ids1, d1)`` host arrays."""
+        fn = self._fn("harvest", _build_harvest_fn, self.capacity)
+        (i0, d0, n0, o0), (i1, d1, n1, o1) = fn(
+            self.engine.syn0, self.engine.syn1, *self._base
+        )
+        payload = (
+            np.asarray(i0), np.asarray(d0), np.asarray(i1), np.asarray(d1),
+        )
+        return (
+            int(n0), int(np.asarray(o0)), int(n1), int(np.asarray(o1)),
+        ), payload
+
+    def _dense_delta(self):
+        """Host fp32 per-rank deltas for a dense/spill round — full
+        (num_rows, dim) per table. Part of the harvest seam: the dense
+        wire payload is by definition a host copy of the table diff."""
+        fn = self._fn("dense", _build_dense_fn)
+        return (
+            np.asarray(fn(self.engine.syn0, self._base[0])),
+            np.asarray(fn(self.engine.syn1, self._base[1])),
+        )
+
+    def _empty_sparse(self):
+        cap, d = self.capacity, self.engine.dim
+        return (
+            np.zeros(cap, np.int32), np.zeros((cap, d), _WIRE_DTYPE),
+            np.zeros(cap, np.int32), np.zeros((cap, d), _WIRE_DTYPE),
+        )
+
+    def _empty_dense(self):
+        v, d = self.engine.num_rows, self.engine.dim
+        z = np.zeros((v, d), _WIRE_DTYPE)
+        return z, z
+
+    # -- the protocol ---------------------------------------------------
+
+    def sync(self, *, live: bool = True, done: bool = False) -> bool:
+        """One exchange round. ``live``: this replica dispatched a group
+        since the last sync (False = empty payload, lockstep filler).
+        ``done``: this replica has no further groups this epoch. Returns
+        True while ANY replica is not done (keep looping)."""
+        eng, tr = self.engine, self.transport
+        t0 = time.time()
+        header = np.zeros(HEADER_LEN, np.int64)
+        header[0], header[1] = int(live), int(done)
+        payload = None
+        if live:
+            (n0, o0, n1, o1), payload = self.harvest()
+            header[2:] = (n0, o0, n1, o1)
+        faults.fire("exchange.pre_send")
+        headers = tr.allgather(header)
+        dense_round = decide_dense(self.mode, headers)
+        sent = headers.nbytes // max(tr.world, 1)
+        touched_ids = None
+        if dense_round:
+            d0, d1 = (
+                self._dense_delta() if live else self._empty_dense()
+            )
+            deltas0 = tr.allgather(d0)
+            deltas1 = tr.allgather(d1)
+            sent += d0.nbytes + d1.nbytes
+            fn = self._fn(
+                "apply_dense", _build_apply_dense_fn, tr.world
+            )
+            syn0, syn1 = fn(*self._base, deltas0, deltas1)
+        else:
+            if payload is None:
+                payload = self._empty_sparse()
+            i0, d0, i1, d1 = payload
+            ids0, ds0 = tr.allgather(i0), tr.allgather(d0)
+            ids1, ds1 = tr.allgather(i1), tr.allgather(d1)
+            sent += i0.nbytes + d0.nbytes + i1.nbytes + d1.nbytes
+            fn = self._fn(
+                "apply_sparse", _build_apply_sparse_fn,
+                self.capacity, tr.world,
+            )
+            syn0, syn1 = fn(*self._base, ids0, ds0, ids1, ds1)
+            touched_ids = np.unique(
+                np.concatenate([ids0.ravel(), ids1.ravel()])
+            )
+        eng.exchange_adopt(syn0, syn1, touched_ids=touched_ids)
+        self.begin()
+        eng._note_exchange(
+            bytes_sent=int(sent),
+            rows=int(header[2] + header[4]),
+            overflow=bool(header[3] or header[5]),
+            dense=bool(dense_round),
+            seconds=time.time() - t0,
+        )
+        return not bool(headers[:, 1].all())
+
+
+def decide_dense(mode: str, headers: np.ndarray) -> bool:
+    """Spill rule shared by the transported and in-process drivers: a
+    round is dense when the configured mode says so, the escape hatch
+    forces it, or ANY replica overflowed its capacity buffer."""
+    if os.environ.get("GLINT_DENSE_EXCHANGE", "0") == "1":
+        return True
+    return mode == "dense" or bool((headers[:, 3] | headers[:, 5]).any())
+
+
+def sync_group(exchangers: Sequence[ReplicaExchanger], *,
+               live: Optional[List[bool]] = None) -> dict:
+    """In-process N-replica exchange round: harvest every replica,
+    decide sparse vs dense with the same spill rule, reconstruct every
+    replica's tables in the same rank order — the single-process driver
+    the weak-scaling harness and the parity tests run replicas through
+    (each replica is its own engine; the "wire" is process memory, but
+    payload bytes are counted exactly as the transported protocol
+    ships them)."""
+    world = len(exchangers)
+    if live is None:
+        live = [True] * world
+    headers = np.zeros((world, HEADER_LEN), np.int64)
+    payloads = []
+    for r, ex in enumerate(exchangers):
+        headers[r, 0] = int(live[r])
+        if live[r]:
+            (n0, o0, n1, o1), p = ex.harvest()
+            headers[r, 2:] = (n0, o0, n1, o1)
+            payloads.append(p)
+        else:
+            payloads.append(None)
+    faults.fire("exchange.pre_send")
+    mode = exchangers[0].mode
+    dense_round = decide_dense(mode, headers)
+    cap = exchangers[0].capacity
+    if dense_round:
+        deltas = [
+            ex._dense_delta() if live[r] else ex._empty_dense()
+            for r, ex in enumerate(exchangers)
+        ]
+        d0 = np.stack([d[0] for d in deltas])
+        d1 = np.stack([d[1] for d in deltas])
+        per_rank = d0[0].nbytes + d1[0].nbytes
+        args = (d0, d1)
+    else:
+        ps = [
+            p if p is not None else ex._empty_sparse()
+            for p, ex in zip(payloads, exchangers)
+        ]
+        ids0 = np.stack([p[0] for p in ps])
+        ds0 = np.stack([p[1] for p in ps])
+        ids1 = np.stack([p[2] for p in ps])
+        ds1 = np.stack([p[3] for p in ps])
+        per_rank = ids0[0].nbytes + ds0[0].nbytes \
+            + ids1[0].nbytes + ds1[0].nbytes
+        args = (ids0, ds0, ids1, ds1)
+    touched_ids = (
+        None if dense_round
+        else np.unique(np.concatenate([args[0].ravel(), args[2].ravel()]))
+    )
+    for r, ex in enumerate(exchangers):
+        t0 = time.time()
+        if dense_round:
+            fn = ex._fn("apply_dense", _build_apply_dense_fn, world)
+        else:
+            fn = ex._fn(
+                "apply_sparse", _build_apply_sparse_fn, cap, world
+            )
+        syn0, syn1 = fn(*ex._base, *args)
+        ex.engine.exchange_adopt(syn0, syn1, touched_ids=touched_ids)
+        ex.begin()
+        ex.engine._note_exchange(
+            bytes_sent=int(per_rank + headers[r].nbytes),
+            rows=int(headers[r, 2] + headers[r, 4]),
+            overflow=bool(headers[r, 3] or headers[r, 5]),
+            dense=bool(dense_round),
+            seconds=time.time() - t0,
+        )
+    return {
+        "dense": bool(dense_round),
+        "bytes_per_rank": int(per_rank),
+        "rows": [int(headers[r, 2] + headers[r, 4]) for r in range(world)],
+    }

@@ -80,6 +80,10 @@ POINTS = {
         "once per fleet health probe of one serving replica",
     "fleet.rollout_step":
         "before each per-replica step of a rolling generation rollout",
+    "exchange.pre_send":
+        "just before a replica-exchange round's cross-rank transport",
+    "ckpt.shard_commit":
+        "after each checkpoint shard block + sidecar manifest write",
 }
 
 _ACTIONS = ("exc", "kill", "hang", "delay")

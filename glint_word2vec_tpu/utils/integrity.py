@@ -28,6 +28,16 @@ logger = logging.getLogger(__name__)
 
 MANIFEST_NAME = "manifest.json"
 
+#: Sidecar manifest of ONE shard file (ISSUE 15 shard-streaming
+#: checkpoints): ``<shard>.npy`` pairs with ``<shard>.npy.manifest.json``
+#: written by whichever rank produced the shard — no single writer ever
+#: needs to see (or hash) the whole table, so integrity survives the
+#: rank-parallel multi-host save path that version-1 manifests could not
+#: cover (they were deleted there). The top-level ``manifest.json``
+#: (version 2) lists shard files by NAME under ``shard_files`` and keeps
+#: inline hashes only for the small non-shard files it wrote itself.
+SHARD_MANIFEST_SUFFIX = ".manifest.json"
+
 
 class CheckpointCorruptError(RuntimeError):
     """A snapshot directory failed integrity verification (missing
@@ -88,6 +98,64 @@ def write_manifest(dirpath: str, manifest: dict, *,
     os.replace(tmp, os.path.join(dirpath, MANIFEST_NAME))
 
 
+def build_shard_manifest(dirpath: str, fname: str,
+                         table_version: Optional[int] = None) -> dict:
+    """Hash + size ONE shard file into its sidecar manifest dict. Runs
+    on whichever thread/rank wrote the shard — one streaming read of
+    that shard alone, never a table gather."""
+    p = os.path.join(dirpath, fname)
+    return {
+        "version": 1,
+        "table_version": table_version,
+        "file": {"sha256": _sha256_file(p), "size": os.path.getsize(p)},
+    }
+
+
+def write_shard_manifest(dirpath: str, fname: str, manifest: dict, *,
+                         fsync: bool = True) -> None:
+    """Write ``<fname>.manifest.json`` next to its shard (atomic
+    replace + optional fsync — the shard's durability contract)."""
+    out = os.path.join(dirpath, fname + SHARD_MANIFEST_SUFFIX)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, out)
+
+
+def _verify_shard(path: str, fname: str, *, deep: bool) -> None:
+    """Verify one shard file against its sidecar manifest; raises
+    :class:`CheckpointCorruptError` naming the shard on any mismatch."""
+    fp = os.path.join(path, fname)
+    mp = fp + SHARD_MANIFEST_SUFFIX
+    if not os.path.exists(fp):
+        raise CheckpointCorruptError(f"{path}: missing shard {fname}")
+    if not os.path.exists(mp):
+        raise CheckpointCorruptError(
+            f"{path}: shard {fname} has no sidecar manifest"
+        )
+    try:
+        with open(mp) as f:
+            ent = json.load(f)["file"]
+    except (ValueError, KeyError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable shard manifest for {fname} ({e})"
+        )
+    size = os.path.getsize(fp)
+    if size != ent["size"]:
+        raise CheckpointCorruptError(
+            f"{path}: shard {fname} is {size} bytes, its manifest says "
+            f"{ent['size']}"
+        )
+    if deep and _sha256_file(fp) != ent["sha256"]:
+        raise CheckpointCorruptError(
+            f"{path}: shard {fname} sha256 mismatch (bit rot or torn "
+            f"write)"
+        )
+
+
 def verify_snapshot_dir(path: str, *, deep: bool = True) -> bool:
     """Verify a snapshot directory against its manifest.
 
@@ -115,6 +183,13 @@ def verify_snapshot_dir(path: str, *, deep: bool = True) -> bool:
         raise CheckpointCorruptError(f"{path}: unreadable manifest ({e})")
     if os.environ.get("GLINT_CKPT_NO_VERIFY", "0") == "1":
         deep = False
+    # Version-2 manifests (ISSUE 15): table shard files are listed by
+    # name and carry their own sidecar manifests — each was hashed by
+    # the rank that wrote it, so the whole-directory verify here is the
+    # sum of per-shard verifies, still without any full-table read into
+    # one buffer.
+    for fname in manifest.get("shard_files", ()):
+        _verify_shard(path, fname, deep=deep)
     for fname, ent in entries.items():
         fp = os.path.join(path, fname)
         if not os.path.exists(fp):

@@ -119,6 +119,21 @@ class Word2VecParams:
     #: Ignored (with a log line) when training routes to the host
     #: batcher, which always builds grid-shaped batches.
     batch_packing: str = "dense"
+    #: Cross-replica reconciliation for multi-process training (ISSUE
+    #: 15, parallel/exchange.py). "none" (default) keeps the SPMD
+    #: global-mesh path (batch payloads exchanged inside every jitted
+    #: step). "sparse" switches pc > 1 runs to data-parallel replicas:
+    #: each process trains its corpus shard on a LOCAL mesh and ships
+    #: only (touched row ids, accumulated fp32 deltas) after every
+    #: dispatch group — wire cost scales with rows touched, not vocab
+    #: size. "dense" ships full per-rank table deltas on the same
+    #: cadence (the parity baseline / escape hatch; also forced by
+    #: GLINT_DENSE_EXCHANGE=1 or any capacity overflow, per round).
+    exchange: str = "none"
+    #: Fixed touched-row buffer capacity per sync (0 = auto-size from
+    #: the dispatch-group pair budget; see exchange.default_capacity).
+    #: Constant shapes keep the whole protocol compile-once.
+    exchange_capacity: int = 0
 
     def __post_init__(self) -> None:
         self.validate()
@@ -153,6 +168,13 @@ class Word2VecParams:
         _require(
             self.batch_packing in ("grid", "dense"),
             "batch_packing must be grid|dense",
+        )
+        _require(
+            self.exchange in ("none", "sparse", "dense"),
+            "exchange must be none|sparse|dense",
+        )
+        _require(
+            self.exchange_capacity >= 0, "exchange_capacity must be >= 0"
         )
 
     def replace(self, **kwargs) -> "Word2VecParams":

@@ -48,10 +48,14 @@ def test_fresh_save_writes_verifiable_manifest(tmp_path):
     eng.save(ck)
     manifest = json.load(open(os.path.join(ck, "manifest.json")))
     assert manifest["table_version"] == eng.table_version
-    # Every snapshot file is covered (data shards + counts + engine.json).
+    # Every snapshot file is covered: small files inline, table shard
+    # blocks by name under shard_files with per-shard sidecar
+    # manifests (ISSUE 15 shard streaming).
     assert "engine.json" in manifest["files"]
     assert "counts.npy" in manifest["files"]
-    assert any(f.startswith("syn0.") for f in manifest["files"])
+    assert any(f.startswith("syn0.") for f in manifest["shard_files"])
+    for f in manifest["shard_files"]:
+        assert os.path.exists(os.path.join(ck, f + ".manifest.json")), f
     assert verify_snapshot_dir(ck) is True
     eng.destroy()
 

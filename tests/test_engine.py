@@ -317,7 +317,14 @@ def test_sharded_save_writes_per_shard_files_and_reloads(tmp_path):
 
     files = sorted(os.listdir(path))
     assert "syn0.npy" not in files  # no full-table file
-    assert sum(f.startswith("syn0.r") for f in files) == 4
+    assert sum(
+        f.startswith("syn0.r") and f.endswith(".npy") for f in files
+    ) == 4
+    # ISSUE 15: every shard block carries its sidecar manifest.
+    assert sum(
+        f.startswith("syn0.r") and f.endswith(".npy.manifest.json")
+        for f in files
+    ) == 4
     with open(os.path.join(path, "engine.json")) as f:
         meta = _json.load(f)
     assert meta["format"] == "sharded"

@@ -1,0 +1,320 @@
+"""Sparse touched-row replica exchange (ISSUE 15, parallel/exchange.py).
+
+The acceptance contracts:
+  * sparse and dense exchange schedules produce value-identical final
+    tables at matched configs (multi-epoch, subsampled, mid-run resume);
+  * every replica leaves every sync with identical tables;
+  * a capacity overflow spills that round to the dense path and parity
+    still holds;
+  * the fit-level wiring (packed + grid) runs the protocol and surfaces
+    its telemetry; GLINT_DENSE_EXCHANGE=1 forces dense rounds;
+  * heartbeat/Prometheus/gang layers carry the new counters lint-clean.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from glint_word2vec_tpu.parallel import exchange as exmod
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+V, D = 157, 16
+
+
+def _engines(world, seed=3, dtype="float32"):
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 100, V)
+    return [
+        EmbeddingEngine(make_mesh(1, 1), V, D, counts, seed=seed,
+                        dtype=dtype)
+        for _ in range(world)
+    ]
+
+
+def _corpus_shard(rank, world, n_words=4000, seed=9):
+    """Deterministic per-rank flat corpus shard (round-robin split of
+    one shared synthetic corpus, like distributed.shard_flat_for_process
+    does for real fits)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, n_words).astype(np.int32)
+    lens = rng.integers(4, 12, 600)
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(np.minimum(lens, 8), out=offsets[1:])
+    offsets = offsets[offsets <= n_words]
+    if offsets[-1] != n_words:
+        offsets = np.append(offsets, n_words)
+    n_sent = len(offsets) - 1
+    picks = np.arange(rank, n_sent, world)
+    out_ids = np.concatenate(
+        [ids[offsets[i]:offsets[i + 1]] for i in picks]
+    )
+    out_offsets = np.zeros(len(picks) + 1, np.int64)
+    np.cumsum(
+        [offsets[i + 1] - offsets[i] for i in picks], out=out_offsets[1:]
+    )
+    return out_ids, out_offsets
+
+
+def _run_replicas(mode, capacity, *, world=2, epochs=2, subsample=False,
+                  resume_after_groups=None, dtype="float32"):
+    """Drive ``world`` in-process replicas through the corpus-resident
+    grid scan with one exchange per dispatch group — the fit loop's
+    schedule, minus the estimator plumbing. Optionally snapshot+reload
+    everything after ``resume_after_groups`` groups (mid-run resume).
+    Returns the rank-0 engine (all replicas are asserted identical)."""
+    engines = _engines(world, dtype=dtype)
+    exs = [
+        exmod.ReplicaExchanger(e, mode=mode, capacity=capacity)
+        for e in engines
+    ]
+    key = jax.random.PRNGKey(5)
+    B, W, spc = 64, 3, 2
+    for r, e in enumerate(engines):
+        ids, offsets = _corpus_shard(r, world)
+        e.upload_corpus(ids, offsets)
+        if subsample:
+            kp = np.clip(
+                np.random.default_rng(2).uniform(0.5, 1.0, V), 0, 1
+            ).astype(np.float32)
+            e.set_keep_probs(kp)
+    groups_done = 0
+    resumed = False
+    epoch = 0
+    while epoch < epochs:
+        n_pos = []
+        for e in engines:
+            if subsample:
+                n_pos.append(e.compact_corpus(jax.random.fold_in(key, epoch)))
+            else:
+                n_pos.append(e.corpus_positions)
+        def _groups(n):
+            steps = max(1, -(-n // B))
+            return max(1, -(-steps // spc))
+
+        groups = max(_groups(n) for n in n_pos)
+        for g in range(groups):
+            for r, e in enumerate(engines):
+                alphas = np.full(spc, 0.02, np.float32)
+                e.train_steps_corpus(
+                    g * spc * B, B, W,
+                    jax.random.fold_in(key, 1000 + r), alphas,
+                    step0=epoch * groups * spc + g * spc,
+                )
+            exmod.sync_group(exs)
+            groups_done += 1
+            if (
+                resume_after_groups is not None and not resumed
+                and groups_done == resume_after_groups
+            ):
+                # Mid-run resume: all replicas are identical post-sync,
+                # so one rank's sharded snapshot restores every rank;
+                # exchangers re-begin on the restored tables.
+                import tempfile
+
+                resumed = True
+                with tempfile.TemporaryDirectory() as td:
+                    path = td + "/snap"
+                    engines[0].save(path)
+                    fresh = _engines(world, dtype=dtype)
+                    for r, e in enumerate(fresh):
+                        e.load_tables(path)
+                        ids, offsets = _corpus_shard(r, world)
+                        e.upload_corpus(ids, offsets)
+                        if subsample:
+                            kp = np.clip(
+                                np.random.default_rng(2).uniform(
+                                    0.5, 1.0, V
+                                ), 0, 1,
+                            ).astype(np.float32)
+                            e.set_keep_probs(kp)
+                            e.compact_corpus(jax.random.fold_in(key, epoch))
+                    for old in engines:
+                        old.destroy()
+                    engines = fresh
+                    exs = [
+                        exmod.ReplicaExchanger(
+                            e, mode=mode, capacity=capacity
+                        )
+                        for e in engines
+                    ]
+        epoch += 1
+    for e in engines[1:]:
+        np.testing.assert_array_equal(
+            np.asarray(engines[0].syn0), np.asarray(e.syn0)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(engines[0].syn1), np.asarray(e.syn1)
+        )
+    return engines[0]
+
+
+def _tables(engine):
+    return (
+        np.asarray(engine.syn0.astype(jax.numpy.float32)),
+        np.asarray(engine.syn1.astype(jax.numpy.float32)),
+    )
+
+
+def test_sparse_vs_dense_parity_multi_epoch():
+    """The tentpole gate: the sparse touched-row schedule reproduces the
+    dense full-delta schedule's tables exactly (2 replicas, 2 epochs)."""
+    sp = _run_replicas("sparse", 1024)
+    de = _run_replicas("dense", 1024)
+    for a, b in zip(_tables(sp), _tables(de)):
+        np.testing.assert_array_equal(a, b)
+    st = sp.exchange_stats()
+    assert st["exchange_syncs_total"] > 0
+    assert st["exchange_dense_syncs_total"] == 0
+    assert st["exchange_rows_total"] > 0
+
+
+def test_sparse_vs_dense_parity_subsampled_resume():
+    """Parity holds through on-device subsample compaction AND a
+    mid-run snapshot/restore (sharded save -> fresh engines)."""
+    sp = _run_replicas("sparse", 1024, subsample=True,
+                       resume_after_groups=3)
+    de = _run_replicas("dense", 1024, subsample=True,
+                       resume_after_groups=3)
+    for a, b in zip(_tables(sp), _tables(de)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overflow_spill_parity():
+    """A capacity too small for the touched set must spill the round to
+    dense — counted, and still value-identical with the dense run."""
+    sp = _run_replicas("sparse", 8, epochs=1)
+    de = _run_replicas("dense", 8, epochs=1)
+    for a, b in zip(_tables(sp), _tables(de)):
+        np.testing.assert_array_equal(a, b)
+    st = sp.exchange_stats()
+    assert st["exchange_overflow_total"] > 0
+    assert st["exchange_dense_syncs_total"] == st["exchange_overflow_total"]
+
+
+def test_bf16_parity():
+    """fp32-wire deltas + round-once reconstruction keep sparse==dense
+    under bf16 table storage too."""
+    sp = _run_replicas("sparse", 1024, epochs=1, dtype="bfloat16")
+    de = _run_replicas("dense", 1024, epochs=1, dtype="bfloat16")
+    for a, b in zip(_tables(sp), _tables(de)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_harvest_exact_touched_rows():
+    """The harvest returns exactly the rows whose values changed, each
+    once (dedup by construction), with fp32 deltas that reconstruct the
+    current table from the base."""
+    (eng,) = _engines(1)
+    ex = exmod.ReplicaExchanger(eng, mode="sparse", capacity=64)
+    rng = np.random.default_rng(0)
+    centers = rng.integers(0, V, 16).astype(np.int32)
+    ctx = rng.integers(0, V, (16, 4)).astype(np.int32)
+    base0 = np.asarray(eng.syn0)
+    eng.train_step(centers, ctx, np.ones((16, 4), np.float32),
+                   jax.random.PRNGKey(1), 0.025)
+    (n0, o0, n1, o1), (i0, d0, i1, d1) = ex.harvest()
+    cur0 = np.asarray(eng.syn0)
+    true_touched = np.where(np.any(cur0 != base0, axis=1))[0]
+    got = np.sort(i0[:n0])
+    np.testing.assert_array_equal(got, true_touched)
+    assert len(np.unique(got)) == n0 and not o0
+    # deltas reconstruct: base + delta == cur for the touched rows
+    rec = base0[i0[:n0], :D].astype(np.float32) + d0[:n0]
+    np.testing.assert_array_equal(rec, cur0[i0[:n0], :D])
+
+
+def test_fit_level_exchange_and_escape_hatch(monkeypatch):
+    """Single-process fit wiring: the exchanger runs every dispatch
+    group, telemetry lands in training_metrics, and the
+    GLINT_DENSE_EXCHANGE=1 escape hatch turns every round dense."""
+    from glint_word2vec_tpu import Word2Vec
+
+    rng = np.random.default_rng(11)
+    words = [f"w{i}" for i in range(60)]
+    sents = [
+        [str(w) for w in rng.choice(words, size=8)] for _ in range(400)
+    ]
+    common = dict(vector_size=16, min_count=1, batch_size=128,
+                  num_iterations=1, seed=3, steps_per_call=4)
+    m = Word2Vec(**common, exchange="sparse").fit(sents)
+    st = m.training_metrics["exchange"]
+    assert m.training_metrics["exchange_mode"] == "sparse"
+    assert st["exchange_syncs_total"] > 0
+    assert st["exchange_dense_syncs_total"] == 0
+
+    monkeypatch.setenv("GLINT_DENSE_EXCHANGE", "1")
+    m2 = Word2Vec(**common, exchange="sparse").fit(sents)
+    st2 = m2.training_metrics["exchange"]
+    assert st2["exchange_syncs_total"] > 0
+    assert st2["exchange_dense_syncs_total"] == st2["exchange_syncs_total"]
+    m.stop()
+    m2.stop()
+
+
+def test_fit_level_exchange_grid_path():
+    """The legacy grid scan gets the same per-group exchange."""
+    from glint_word2vec_tpu import Word2Vec
+
+    rng = np.random.default_rng(12)
+    words = [f"w{i}" for i in range(40)]
+    sents = [
+        [str(w) for w in rng.choice(words, size=6)] for _ in range(300)
+    ]
+    m = Word2Vec(
+        vector_size=16, min_count=1, batch_size=128, num_iterations=1,
+        seed=3, steps_per_call=4, batch_packing="grid", exchange="sparse",
+    ).fit(sents)
+    assert m.training_metrics["exchange"]["exchange_syncs_total"] > 0
+    m.stop()
+
+
+def test_exchange_telemetry_through_obs_layers():
+    """Heartbeat snapshot carries the exchange + shard-checkpoint keys,
+    both Prometheus renderers emit them lint-clean, and the gang
+    aggregate sums them across ranks."""
+    from glint_word2vec_tpu.obs.aggregate import merge_training_snapshots
+    from glint_word2vec_tpu.obs.heartbeat import TrainingStatus
+    from glint_word2vec_tpu.obs.prometheus import (
+        gang_to_prometheus,
+        lint_prometheus_text,
+        training_to_prometheus,
+    )
+
+    (eng,) = _engines(1)
+    ex = exmod.ReplicaExchanger(eng, mode="sparse", capacity=64)
+    rng = np.random.default_rng(0)
+    eng.train_step(
+        rng.integers(0, V, 16).astype(np.int32),
+        rng.integers(0, V, (16, 4)).astype(np.int32),
+        np.ones((16, 4), np.float32), jax.random.PRNGKey(1), 0.025,
+    )
+    ex.sync()
+    status = TrainingStatus(pipeline="device_corpus", engine=eng)
+    snap = status.snapshot(include_devices=False)
+    assert snap["exchange_syncs_total"] == 1
+    assert snap["exchange_bytes_total"] > 0
+    assert "checkpoint_shards_skipped" in snap
+    text = training_to_prometheus(snap)
+    assert not lint_prometheus_text(text)
+    assert "glint_training_exchange_bytes_total" in text
+
+    merged = merge_training_snapshots({0: snap, 1: snap})
+    assert merged["counters"]["exchange_bytes_total"] == \
+        2 * snap["exchange_bytes_total"]
+    gtext = gang_to_prometheus(merged)
+    assert not lint_prometheus_text(gtext)
+    assert "glint_gang_exchange_rows_total" in gtext
+    eng.destroy()
+
+
+def test_exchange_capacity_validation():
+    from glint_word2vec_tpu.utils.params import Word2VecParams
+
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange="bogus")
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange_capacity=-1)
+    p = Word2VecParams(exchange="sparse", exchange_capacity=128)
+    assert p.exchange == "sparse"

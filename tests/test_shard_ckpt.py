@@ -1,0 +1,248 @@
+"""Shard-streaming checkpoints (ISSUE 15): per-shard sidecar manifests,
+one-block peak host memory on save AND restore, corrupt-shard fallback
+to the previous committed snapshot, skip-clean in-place re-saves, and
+the replica save split."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from glint_word2vec_tpu.parallel.engine import EmbeddingEngine
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.utils import integrity
+
+V, D = 203, 16
+
+
+def _engine(mesh=None, seed=3):
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 100, V)
+    return EmbeddingEngine(
+        mesh or make_mesh(1, 2), V, D, counts, seed=seed
+    )
+
+
+def _step(engine, seed=0, alpha=0.025):
+    rng = np.random.default_rng(seed)
+    engine.train_step(
+        rng.integers(0, V, 32).astype(np.int32),
+        rng.integers(0, V, (32, 4)).astype(np.int32),
+        np.ones((32, 4), np.float32),
+        jax.random.PRNGKey(seed), alpha,
+    )
+
+
+def test_per_shard_manifests_and_verify(tmp_path):
+    """A sharded save writes one sidecar manifest per shard block, a
+    version-2 top manifest naming them, and the whole directory
+    verifies; flipping bytes in any single shard is detected and names
+    the shard."""
+    eng = _engine()
+    _step(eng)
+    # Two steps so syn0 moves too (first-step syn0 updates are zero:
+    # syn1 starts at 0, so d_center = sum(coef * u) = 0).
+    _step(eng, seed=1)
+    path = str(tmp_path / "snap")
+    eng.save(path)
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert man["version"] == 2
+    assert len(man["shard_files"]) == 4  # 2 tables x 2 model shards
+    for fname in man["shard_files"]:
+        side = os.path.join(
+            path, fname + integrity.SHARD_MANIFEST_SUFFIX
+        )
+        assert os.path.exists(side), fname
+        ent = json.load(open(side))["file"]
+        assert ent["size"] == os.path.getsize(os.path.join(path, fname))
+    assert integrity.verify_snapshot_dir(path) is True
+
+    bad = man["shard_files"][-1]
+    with open(os.path.join(path, bad), "r+b") as f:
+        f.seek(300)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(integrity.CheckpointCorruptError) as ei:
+        integrity.verify_snapshot_dir(path)
+    assert bad in str(ei.value)
+    eng.destroy()
+
+
+def test_save_restore_peak_bounded_by_one_shard(tmp_path):
+    """The blocking sharded save materializes one block at a time
+    (never a full-table host gather), and the restore assembles each
+    device shard from mmap slices — both peaks are a shard, not a
+    table."""
+    eng = _engine()
+    _step(eng)
+    path = str(tmp_path / "snap")
+    eng.save(path)
+    st = eng.checkpoint_stats()
+    table_bytes = eng.padded_vocab * eng.padded_dim * 4
+    shard_bytes = (eng.padded_vocab // 2) * eng.padded_dim * 4
+    # counts.npy rides along eagerly; everything else streams.
+    slack = V * 8 + 4096
+    assert st["checkpoint_peak_block_bytes"] <= shard_bytes + slack, st
+    assert st["checkpoint_peak_block_bytes"] < table_bytes
+    assert st["checkpoint_shard_write_seconds"] is not None
+
+    dst = _engine(make_mesh(1, 2), seed=7)
+    staged = dst.stage_tables(path)
+    dst.adopt_tables(staged)
+    np.testing.assert_array_equal(
+        np.asarray(eng.syn0)[:V, :D], np.asarray(dst.syn0)[:V, :D]
+    )
+    # Each assemble produced at most one device-shard-sized buffer.
+    assert 0 < dst._stage_peak_block_bytes <= table_bytes // 2 + 4096
+    assert dst.checkpoint_stats()["checkpoint_shard_verify_seconds"] \
+        is not None
+    eng.destroy()
+    dst.destroy()
+
+
+def test_corrupt_shard_falls_back_to_previous_snapshot(tmp_path):
+    """resolve_train_state: a corrupt shard in the newest committed
+    snapshot (detected via its sidecar manifest) falls back to the
+    previous committed snapshot instead of loading garbage."""
+    eng = _engine()
+    _step(eng)
+    ck1 = str(tmp_path / "ckpt-1")
+    eng.save(ck1)
+    _step(eng, seed=2)
+    ck2 = str(tmp_path / "ckpt-2")
+    eng.save(ck2)
+    state = {
+        "epochs_completed": 2, "step": 2, "words_done": 64,
+        "ckpt": "ckpt-2",
+        "prev": {"epochs_completed": 1, "step": 1, "words_done": 32,
+                 "ckpt": "ckpt-1"},
+    }
+    with open(tmp_path / "train_state.json", "w") as f:
+        json.dump(state, f)
+
+    rec, path = integrity.resolve_train_state(str(tmp_path))
+    assert rec["ckpt"] == "ckpt-2" and path == ck2
+
+    shard = json.load(open(os.path.join(ck2, "manifest.json")))[
+        "shard_files"
+    ][0]
+    with open(os.path.join(ck2, shard), "r+b") as f:
+        f.seek(128)
+        f.write(b"\x00" * 8 + b"\xff" * 8)
+    rec, path = integrity.resolve_train_state(str(tmp_path))
+    assert rec["ckpt"] == "ckpt-1" and path == ck1
+    eng.destroy()
+
+
+def test_skip_clean_shards_in_place(tmp_path):
+    """In-place re-saves skip (and never host-copy) shards unchanged
+    since the last committed save to the same path; any mutation marks
+    everything dirty again; an exchange round narrows dirtiness to the
+    rows it actually touched."""
+    eng = _engine()
+    _step(eng)
+    path = str(tmp_path / "model")
+    eng.save(path)
+    assert eng.checkpoint_stats()["checkpoint_shards_skipped"] == 0
+
+    eng.save(path)  # nothing changed: all 4 shard files skip
+    assert eng.checkpoint_stats()["checkpoint_shards_skipped"] == 4
+
+    _step(eng, seed=2)  # generic mutation: everything dirty again
+    eng.save(path)
+    assert eng.checkpoint_stats()["checkpoint_shards_skipped"] == 4
+
+    # Exchange adoption narrows the dirty set: touch only rows in the
+    # FIRST row block -> the second block's two shard files skip.
+    per_shard = eng.padded_vocab // 2
+    touched = np.arange(4, dtype=np.int64)
+    assert touched.max() < per_shard
+    eng.exchange_adopt(eng.syn0, eng.syn1, touched_ids=touched)
+    eng.save(path)
+    assert eng.checkpoint_stats()["checkpoint_shards_skipped"] == 6
+    assert integrity.verify_snapshot_dir(path) is True
+
+    # Stale-bytes regression: a narrow exchange mark AFTER a generic
+    # mutation must not shrink the all-dirty state — the next save may
+    # skip NOTHING (skipping would commit stale shard bytes that still
+    # verify against their equally-stale sidecars).
+    _step(eng, seed=3)  # unknown mutation: everything dirty
+    eng.exchange_adopt(eng.syn0, eng.syn1, touched_ids=touched)
+    before = eng.checkpoint_stats()["checkpoint_shards_skipped"]
+    eng.save(path)
+    assert eng.checkpoint_stats()["checkpoint_shards_skipped"] == before
+    assert integrity.verify_snapshot_dir(path) is True
+    eng.destroy()
+
+
+def test_replica_save_split_assembles_and_reloads(tmp_path):
+    """Two replica engines with identical tables, each configured to
+    write its own row block (set_save_split), together produce one
+    complete verifiable snapshot that reloads onto any mesh — the
+    rank-parallel checkpoint path of replica-exchange training."""
+    e0 = _engine(make_mesh(1, 1))
+    e1 = _engine(make_mesh(1, 1))
+    _step(e0)
+    _step(e1)  # same seeds: identical tables
+    np.testing.assert_array_equal(
+        np.asarray(e0.syn0), np.asarray(e1.syn0)
+    )
+    e0.set_save_split(0, 2)
+    e1.set_save_split(1, 2)
+    path = str(tmp_path / "snap")
+    e0.save(path)  # fresh dir: rank 0's blocks + meta + counts
+    # Ownership: rank 0 wrote ONLY its own row block of each table.
+    per_shard = -(-e0.padded_vocab // 2)
+    r1_block = f"syn0.r{per_shard:012d}.npy"
+    assert os.path.exists(os.path.join(path, "syn0.r000000000000.npy"))
+    assert not os.path.exists(os.path.join(path, r1_block)), (
+        "rank 0 wrote rank 1's block"
+    )
+    e1.save(path)  # in-place: rank 1 adds its blocks + sidecars
+    assert os.path.exists(os.path.join(path, r1_block))
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert len(man["shard_files"]) == 4  # 2 tables x 2 split blocks
+    assert integrity.verify_snapshot_dir(path) is True
+
+    dst = _engine(make_mesh(1, 2), seed=11)
+    dst.load_tables(path)
+    np.testing.assert_array_equal(
+        np.asarray(e0.syn0)[:V, :D], np.asarray(dst.syn0)[:V, :D]
+    )
+    e0.destroy()
+    e1.destroy()
+    dst.destroy()
+
+
+def test_async_save_keeps_sidecars(tmp_path):
+    """The async writer path produces the same per-shard sidecar
+    manifests and verifiable directory as the blocking path."""
+    eng = _engine()
+    _step(eng)
+    path = str(tmp_path / "snap")
+    committed = []
+    assert eng.save_async(path, on_commit=lambda: committed.append(1))
+    eng.wait_pending_saves()
+    assert committed == [1]
+    assert integrity.verify_snapshot_dir(path) is True
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert man["version"] == 2 and len(man["shard_files"]) == 4
+    eng.destroy()
+
+
+def test_shard_commit_fault_point(tmp_path):
+    """ckpt.shard_commit fires per shard block written (the drill seam
+    for torn-shard chaos tests)."""
+    from glint_word2vec_tpu.utils import faults
+
+    eng = _engine()
+    _step(eng)
+    faults.arm("ckpt.shard_commit:exc@2")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            eng.save(str(tmp_path / "snap"))
+    finally:
+        faults.disarm()
+    eng.destroy()
