@@ -169,6 +169,11 @@ SYNC_SEAMS: Dict[str, str] = {
         "the exchange round itself: a deliberate reconciliation "
         "barrier between dispatch groups (headers and payloads are "
         "host numpy)",
+    "glint_word2vec_tpu/parallel/exchange.py::"
+    "ReplicaExchanger._twolevel_round":
+        "level-1/level-2 legs of the sync seam (ISSUE 16): node fold "
+        "and leader payloads are host wire traffic of the same "
+        "reconciliation barrier",
     "glint_word2vec_tpu/parallel/exchange.py::sync_group":
         "in-process N-replica exchange driver (tests/harness): same "
         "reconciliation barrier as ReplicaExchanger.sync",

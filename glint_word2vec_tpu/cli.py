@@ -73,7 +73,32 @@ def _add_train(sub):
     p.add_argument("--exchange-capacity", type=int, default=0,
                    help="fixed touched-row buffer capacity per exchange "
                         "sync (0 = auto-sized from the dispatch-group "
-                        "pair budget)")
+                        "pair budget, then adapted down from observed "
+                        "telemetry; nonzero pins it)")
+    p.add_argument("--exchange-wire", choices=["fp32", "bf16", "int8"],
+                   default="fp32",
+                   help="sparse exchange payload encoding (ISSUE 16): "
+                        "fp32 exact, bf16 half-width, or int8 with "
+                        "per-row maxabs scales and error-feedback "
+                        "residual carry (unbiased update stream); "
+                        "dense/spill/flush rounds always ship fp32")
+    p.add_argument("--exchange-every", type=int, default=1,
+                   help="coalesce this many dispatch groups into one "
+                        "exchange round (hot rows repeatedly touched "
+                        "in the window cost one wire row); 1 = sync "
+                        "every group")
+    p.add_argument("--exchange-topology", choices=["flat", "twolevel"],
+                   default="flat",
+                   help="exchange sync topology: flat allgather, or "
+                        "twolevel — exact intra-node hop + leaders-only "
+                        "quantized inter-node hop (GLINT_RANKS_PER_NODE "
+                        "sets the node size)")
+    p.add_argument("--exchange-shard",
+                   choices=["roundrobin", "locality"],
+                   default="roundrobin",
+                   help="replica corpus sharding: roundrobin interleave "
+                        "or locality (sentences clustered by rarest "
+                        "token to concentrate per-rank touched rows)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable epoch-granular checkpoint/resume")
     p.add_argument("--checkpoint-every", type=int, default=1,
@@ -930,6 +955,10 @@ def _run(args) -> int:
             batch_packing=args.packing,
             exchange=args.exchange,
             exchange_capacity=args.exchange_capacity,
+            exchange_wire=args.exchange_wire,
+            exchange_every=args.exchange_every,
+            exchange_topology=args.exchange_topology,
+            exchange_shard=args.exchange_shard,
         )
         obs = None
         if (args.status_port is not None or args.status_file

@@ -327,8 +327,32 @@ class Word2Vec:
 
     def set_exchange_capacity(self, v: int) -> "Word2Vec":
         """Fixed touched-row buffer capacity per exchange sync (0 =
-        auto-sized from the dispatch-group pair budget)."""
+        auto-sized from the dispatch-group pair budget, then adapted
+        down from observed telemetry; nonzero pins it)."""
         return self._set(exchange_capacity=v)
+
+    def set_exchange_wire(self, v: str) -> "Word2Vec":
+        """Sparse exchange payload encoding (ISSUE 16): "fp32" (exact),
+        "bf16", or "int8" (per-row maxabs scale with error-feedback
+        residual carry). See README "Pod-scale training"."""
+        return self._set(exchange_wire=v)
+
+    def set_exchange_every(self, v: int) -> "Word2Vec":
+        """Coalesce R dispatch groups into one exchange round (ISSUE
+        16); 1 = sync every group."""
+        return self._set(exchange_every=v)
+
+    def set_exchange_topology(self, v: str) -> "Word2Vec":
+        """Exchange sync topology (ISSUE 16): "flat" or "twolevel"
+        (intra-node exact hop + leaders-only quantized inter-node
+        hop; GLINT_RANKS_PER_NODE sets the node size)."""
+        return self._set(exchange_topology=v)
+
+    def set_exchange_shard(self, v: str) -> "Word2Vec":
+        """Replica corpus sharding: "roundrobin" or "locality"
+        (sentences clustered by rarest token to concentrate each
+        replica's touched rows; ISSUE 16)."""
+        return self._set(exchange_shard=v)
 
     def set_observability(self, obs) -> "Word2Vec":
         """Attach an :class:`obs.ObsConfig` for subsequent fits (event
@@ -554,7 +578,10 @@ class Word2Vec:
         value-identical again."""
         from glint_word2vec_tpu.parallel import distributed as dist
 
-        ids, offsets = dist.shard_flat_for_process(ids, offsets)
+        if self.params.exchange_shard == "locality":
+            ids, offsets = dist.shard_flat_locality(ids, offsets)
+        else:
+            ids, offsets = dist.shard_flat_for_process(ids, offsets)
         # graftlint: ignore[sync-point] ids is host numpy here
         if not self._device_corpus_eligible(int(ids.size)):
             raise ValueError(
@@ -790,6 +817,9 @@ class Word2Vec:
                     transport=transport,
                     pair_batch=pair_batch if packed else B,
                     steps_per_call=spc,
+                    wire=p.exchange_wire,
+                    every=p.exchange_every,
+                    topology=p.exchange_topology,
                 )
             # Mutated by _harvest_packed (declared before the epoch loop
             # so the closure binds the method scope, not a loop body).
@@ -961,7 +991,7 @@ class Word2Vec:
                                 with metrics.timing("step"), obs_run.span(
                                     "exchange_sync", packed=True
                                 ):
-                                    gang_live = exchanger.sync(
+                                    gang_live = exchanger.group_end(
                                         live=True, done=pos >= n_pos
                                     )
                             if (
@@ -987,9 +1017,10 @@ class Word2Vec:
                             with metrics.timing("step"), obs_run.span(
                                 "exchange_sync", filler=True
                             ):
-                                gang_live = exchanger.sync(
+                                gang_live = exchanger.group_end(
                                     live=False, done=True
                                 )
+                        exchanger.epoch_reset()
                     # Drop the phantom tail group's keys (if any) so the
                     # next epoch's step0 matches the synchronous loop.
                     dstep = step
@@ -1090,7 +1121,7 @@ class Word2Vec:
                             with metrics.timing("step"), obs_run.span(
                                 "exchange_sync"
                             ):
-                                gang_live = exchanger.sync(
+                                gang_live = exchanger.group_end(
                                     live=True, done=(g == groups - 1)
                                 )
                     if exchanger is not None:
@@ -1099,9 +1130,10 @@ class Word2Vec:
                             with metrics.timing("step"), obs_run.span(
                                 "exchange_sync", filler=True
                             ):
-                                gang_live = exchanger.sync(
+                                gang_live = exchanger.group_end(
                                     live=False, done=True
                                 )
+                        exchanger.epoch_reset()
                     gstep = step
                     # Grid dispatches are asynchronous: the tail group is
                     # still executing here, so the next epoch's
@@ -1115,6 +1147,15 @@ class Word2Vec:
                     stopping
                     or (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
                 ):
+                    if exchanger is not None:
+                        # Drain the error-feedback carry through one
+                        # exact wire round (no-op unless the int8 wire
+                        # accumulated one) so a resume from this
+                        # checkpoint replays bitwise against the
+                        # uninterrupted run. Config-gated on every
+                        # rank identically — collective-safe.
+                        with obs_run.span("exchange_flush"):
+                            exchanger.flush()
                     ck_name = f"ckpt-{epoch + 1}"
                     _checkpoint_tables(
                         engine, obs_run, metrics,
@@ -1132,6 +1173,12 @@ class Word2Vec:
                             extra={
                                 "position": 0, "gstep": gstep,
                                 "batch_packing": p.batch_packing,
+                                # Exchange wire config at write time:
+                                # a resumed run replays bitwise only
+                                # under the same (wire, every) cell
+                                # (the flush above zeroed the carry).
+                                "exchange_wire": p.exchange_wire,
+                                "exchange_every": p.exchange_every,
                             },
                         ),
                     )
@@ -1172,6 +1219,9 @@ class Word2Vec:
         model.training_metrics["batch_packing"] = p.batch_packing
         if exchanger is not None:
             model.training_metrics["exchange_mode"] = p.exchange
+            model.training_metrics["exchange_wire"] = p.exchange_wire
+            model.training_metrics["exchange_every"] = p.exchange_every
+            model.training_metrics["exchange_topology"] = p.exchange_topology
             model.training_metrics["exchange"] = engine.exchange_stats()
         if packed and packed_slots:
             # Packed fill = live pairs / dispatched pair slots — the

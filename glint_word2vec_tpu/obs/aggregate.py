@@ -57,6 +57,11 @@ _SUM_COUNTERS = (
     ("exchange_bytes_total", "exchange_bytes_total"),
     ("exchange_rows_total", "exchange_rows_total"),
     ("exchange_overflow_total", "exchange_overflow_total"),
+    # ISSUE 16 wire-layer rollups: coalesced dispatch groups and the
+    # per-hop byte split of the two-level topology.
+    ("exchange_groups_total", "exchange_groups_total"),
+    ("exchange_intra_bytes_total", "exchange_intra_bytes_total"),
+    ("exchange_inter_bytes_total", "exchange_inter_bytes_total"),
     ("checkpoint_shards_skipped_total", "checkpoint_shards_skipped"),
 )
 

@@ -297,6 +297,28 @@ class TrainingStatus:
                 snap["exchange_syncs_total"] = exs.get(
                     "exchange_syncs_total", 0
                 )
+                # ISSUE 16 wire-layer counters: bytes by wire format,
+                # coalesced dispatch groups, flush rounds, world=1
+                # skips, the two-level per-hop byte split, the live
+                # capacity gauge (+ adaptation counters), and the
+                # error-feedback residual gauge.
+                for k in (
+                    "exchange_bytes_wire_fp32_total",
+                    "exchange_bytes_wire_bf16_total",
+                    "exchange_bytes_wire_int8_total",
+                    "exchange_groups_total",
+                    "exchange_flushes_total",
+                    "exchange_world1_skips_total",
+                    "exchange_intra_bytes_total",
+                    "exchange_inter_bytes_total",
+                    "exchange_capacity_grows_total",
+                    "exchange_capacity_shrinks_total",
+                ):
+                    snap[k] = exs.get(k, 0)
+                snap["exchange_capacity"] = exs.get("exchange_capacity")
+                snap["exchange_residual_abs"] = exs.get(
+                    "exchange_residual_abs", 0.0
+                )
         if rec is not None:
             snap["events"] = rec.counts()
         if ledger is not None:

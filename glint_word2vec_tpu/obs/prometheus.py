@@ -102,6 +102,13 @@ def training_to_prometheus(snap: dict) -> str:
          "checkpoint_shard_verify_seconds",
          "Seconds verifying per-shard manifests in the most recent "
          "checkpoint stage/restore (NaN before any)."),
+        ("glint_training_exchange_capacity", "exchange_capacity",
+         "Live touched-row exchange buffer capacity (adapts from the "
+         "observed high-water mark unless pinned; NaN before any "
+         "exchange round)."),
+        ("glint_training_exchange_residual_abs", "exchange_residual_abs",
+         "Max-abs of the int8 error-feedback residual carry after the "
+         "latest encode (0 on exact wires and right after a flush)."),
         ("glint_training_uptime_seconds", "uptime_seconds",
          "Seconds since the fit's observability run started."),
         ("glint_training_table_version", "table_version",
@@ -139,6 +146,45 @@ def training_to_prometheus(snap: dict) -> str:
          "buffer and spilled to the dense path."),
         ("glint_training_exchange_syncs_total", "exchange_syncs_total",
          "Replica-exchange reconciliation rounds completed."),
+        ("glint_training_exchange_bytes_wire_fp32_total",
+         "exchange_bytes_wire_fp32_total",
+         "Exchange bytes shipped on fp32-encoded rounds (exact sparse "
+         "wire, plus every dense/spill/flush round)."),
+        ("glint_training_exchange_bytes_wire_bf16_total",
+         "exchange_bytes_wire_bf16_total",
+         "Exchange bytes shipped on bf16-encoded sparse rounds."),
+        ("glint_training_exchange_bytes_wire_int8_total",
+         "exchange_bytes_wire_int8_total",
+         "Exchange bytes shipped on int8-encoded sparse rounds "
+         "(per-row maxabs scales + error feedback)."),
+        ("glint_training_exchange_groups_total",
+         "exchange_groups_total",
+         "Dispatch groups folded into exchange rounds (> syncs when "
+         "round coalescing accumulates several groups per round)."),
+        ("glint_training_exchange_flushes_total",
+         "exchange_flushes_total",
+         "Checkpoint flush rounds (error-feedback carry drained "
+         "through an exact fp32 wire round)."),
+        ("glint_training_exchange_world1_skips_total",
+         "exchange_world1_skips_total",
+         "Exchange rounds short-circuited at world=1 (no wire, zero "
+         "bytes)."),
+        ("glint_training_exchange_intra_bytes_total",
+         "exchange_intra_bytes_total",
+         "Two-level exchange bytes attributed to the fast intra-node "
+         "hop (exact fp32 local payloads)."),
+        ("glint_training_exchange_inter_bytes_total",
+         "exchange_inter_bytes_total",
+         "Exchange bytes attributed to the slow inter-node hop "
+         "(leaders-only quantized node payloads under the two-level "
+         "topology; every byte of a flat round)."),
+        ("glint_training_exchange_capacity_grows_total",
+         "exchange_capacity_grows_total",
+         "Adaptive capacity grow events (after an overflow spill)."),
+        ("glint_training_exchange_capacity_shrinks_total",
+         "exchange_capacity_shrinks_total",
+         "Adaptive capacity shrink events (rolling high-water mark "
+         "with 2x headroom hysteresis)."),
         ("glint_training_checkpoint_shards_skipped_total",
          "checkpoint_shards_skipped",
          "In-place checkpoint shard writes skipped because the shard "
@@ -288,6 +334,16 @@ def gang_to_prometheus(snap: dict) -> str:
          "Touched rows shipped through the exchange summed over ranks."),
         ("glint_gang_exchange_overflow_total", "exchange_overflow_total",
          "Capacity-overflow dense spills summed over ranks."),
+        ("glint_gang_exchange_groups_total", "exchange_groups_total",
+         "Dispatch groups folded into exchange rounds summed over "
+         "ranks (coalescing rollup)."),
+        ("glint_gang_exchange_intra_bytes_total",
+         "exchange_intra_bytes_total",
+         "Two-level intra-node hop bytes summed over ranks."),
+        ("glint_gang_exchange_inter_bytes_total",
+         "exchange_inter_bytes_total",
+         "Slow-hop (inter-node / flat) exchange bytes summed over "
+         "ranks."),
         ("glint_gang_checkpoint_shards_skipped_total",
          "checkpoint_shards_skipped_total",
          "Clean checkpoint shards skipped in-place summed over ranks."),

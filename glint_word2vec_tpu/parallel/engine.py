@@ -1302,6 +1302,25 @@ class EmbeddingEngine:
             "exchange_syncs_total": 0,
             "exchange_dense_syncs_total": 0,
             "exchange_last_seconds": None,
+            # ISSUE 16 wire-layer telemetry: payload bytes by wire
+            # encoding (dense/spill/flush rounds count as fp32 — that
+            # is what they ship), dispatch groups folded into rounds
+            # by coalescing, checkpoint flush rounds, world=1 skipped
+            # rounds, per-hop byte split for the two-level topology,
+            # the live capacity gauge with its adaptation counters,
+            # and the error-feedback residual high-water gauge.
+            "exchange_bytes_wire_fp32_total": 0,
+            "exchange_bytes_wire_bf16_total": 0,
+            "exchange_bytes_wire_int8_total": 0,
+            "exchange_groups_total": 0,
+            "exchange_flushes_total": 0,
+            "exchange_world1_skips_total": 0,
+            "exchange_intra_bytes_total": 0,
+            "exchange_inter_bytes_total": 0,
+            "exchange_capacity": None,
+            "exchange_capacity_grows_total": 0,
+            "exchange_capacity_shrinks_total": 0,
+            "exchange_residual_abs": 0.0,
         }
         # Per-shard checkpoint bookkeeping (ISSUE 15): which shard
         # files are dirty since the last committed save (None = all —
@@ -1861,7 +1880,12 @@ class EmbeddingEngine:
 
     def _note_exchange(self, *, bytes_sent: int, rows: int,
                        overflow: bool, dense: bool,
-                       seconds: float) -> None:
+                       seconds: float, wire: str = "fp32",
+                       groups: int = 1, flush: bool = False,
+                       world1_skip: bool = False, intra_bytes: int = 0,
+                       capacity: Optional[int] = None,
+                       cap_event: Optional[str] = None,
+                       residual_abs: float = 0.0) -> None:
         st = self._exchange_stats
         st["exchange_bytes_total"] += int(bytes_sent)  # graftlint: ignore[sync-point] host stat
         st["exchange_rows_total"] += int(rows)  # graftlint: ignore[sync-point] host stat
@@ -1869,6 +1893,21 @@ class EmbeddingEngine:
         st["exchange_syncs_total"] += 1
         st["exchange_dense_syncs_total"] += int(bool(dense))
         st["exchange_last_seconds"] = round(float(seconds), 6)  # graftlint: ignore[sync-point] host stat
+        wire_key = "exchange_bytes_wire_%s_total" % (
+            wire if wire in ("fp32", "bf16", "int8") else "fp32"
+        )
+        st[wire_key] += int(bytes_sent)  # graftlint: ignore[sync-point] host stat
+        st["exchange_groups_total"] += int(groups)  # graftlint: ignore[sync-point] host stat
+        st["exchange_flushes_total"] += int(bool(flush))
+        st["exchange_world1_skips_total"] += int(bool(world1_skip))
+        st["exchange_intra_bytes_total"] += int(intra_bytes)  # graftlint: ignore[sync-point] host stat
+        inter = max(int(bytes_sent) - int(intra_bytes), 0)  # graftlint: ignore[sync-point] host stat
+        st["exchange_inter_bytes_total"] += inter  # graftlint: ignore[sync-point] host stat
+        if capacity is not None:
+            st["exchange_capacity"] = int(capacity)  # graftlint: ignore[sync-point] host stat
+        st["exchange_capacity_grows_total"] += int(cap_event == "grow")
+        st["exchange_capacity_shrinks_total"] += int(cap_event == "shrink")
+        st["exchange_residual_abs"] = float(residual_abs)  # graftlint: ignore[sync-point] host stat
 
     def exchange_stats(self) -> dict:
         """Replica-exchange telemetry for the heartbeat (zeros until a

@@ -131,9 +131,36 @@ class Word2VecParams:
     #: GLINT_DENSE_EXCHANGE=1 or any capacity overflow, per round).
     exchange: str = "none"
     #: Fixed touched-row buffer capacity per sync (0 = auto-size from
-    #: the dispatch-group pair budget; see exchange.default_capacity).
-    #: Constant shapes keep the whole protocol compile-once.
+    #: the dispatch-group pair budget, then adapt down from the
+    #: observed touched-row high-water mark; see
+    #: exchange.default_capacity. A nonzero value PINS the capacity —
+    #: no adaptation). Constant shapes keep the protocol compile-once.
     exchange_capacity: int = 0
+    #: Sparse exchange payload encoding (ISSUE 16): "fp32" (exact),
+    #: "bf16" (half the payload, one rounding per component), or
+    #: "int8" (per-row maxabs scale + error feedback — the local
+    #: quantization residual folds into the next round, keeping the
+    #: update stream unbiased). Dense/spill/flush rounds always ship
+    #: exact fp32. Ignored unless exchange="sparse".
+    exchange_wire: str = "fp32"
+    #: Round coalescing (ISSUE 16): run a wire round every R dispatch
+    #: groups instead of every group — repeated touches of a hot row
+    #: within the window cost one wire row. 1 = sync every group (the
+    #: PR 15 cadence).
+    exchange_every: int = 1
+    #: Exchange sync topology (ISSUE 16): "flat" allgathers every
+    #: rank's payload (the PR 15 protocol); "twolevel" ships exact
+    #: fp32 sparse payloads on the fast intra-node hop, folds them
+    #: into one node delta, and only node LEADERS ship the quantized
+    #: node payload over the slow inter-node hop (Ji et al.
+    #: arXiv:1604.04661; GLINT_RANKS_PER_NODE sets the node size).
+    exchange_topology: str = "flat"
+    #: Replica corpus sharding: "roundrobin" (the PR 15 interleave) or
+    #: "locality" — sentences clustered by their rarest token so each
+    #: replica's touched-row set concentrates, shrinking the
+    #: touched-row unions that size every exchange buffer
+    #: (arXiv:1909.03359).
+    exchange_shard: str = "roundrobin"
 
     def __post_init__(self) -> None:
         self.validate()
@@ -175,6 +202,19 @@ class Word2VecParams:
         )
         _require(
             self.exchange_capacity >= 0, "exchange_capacity must be >= 0"
+        )
+        _require(
+            self.exchange_wire in ("fp32", "bf16", "int8"),
+            "exchange_wire must be fp32|bf16|int8",
+        )
+        _require(self.exchange_every >= 1, "exchange_every must be >= 1")
+        _require(
+            self.exchange_topology in ("flat", "twolevel"),
+            "exchange_topology must be flat|twolevel",
+        )
+        _require(
+            self.exchange_shard in ("roundrobin", "locality"),
+            "exchange_shard must be roundrobin|locality",
         )
 
     def replace(self, **kwargs) -> "Word2VecParams":

@@ -13,6 +13,18 @@ What it measures, into ``MULTICHIP_BENCH.json`` (repo root):
   * **Bytes on the wire**: sparse vs dense exchange bytes per sync at a
     matched 2-rank config — the tentpole gate is sparse moving >= 5x
     fewer bytes/step than the dense full-delta schedule.
+  * **Wire variants** (ISSUE 16): the per-variant bytes surface at a
+    matched config — fp32/bf16/int8 wire encodings, int8 + round
+    coalescing (every=2), and the two-level topology's intra/inter
+    hop split — each with replica identity and drift vs the fp32
+    baseline. The new gate is int8+coalesced moving >= 3x fewer
+    bytes per dispatch group than fp32 sparse.
+  * **world=1 short-circuit**: the single-rank sweep leg reports
+    exchange bytes/sync == 0 (one replica reconciling with itself
+    skips the wire entirely).
+  * **Per-wire quality**: a fit per wire format (int8 coalesced
+    included) over the capital-structure corpus clearing the
+    vienna/berlin gates — quantization must not cost the analogy.
   * **Parity**: sparse-vs-dense final tables value-identical at a
     matched in-process 2-replica config (plus an overflow-spill leg),
     and every worker of every world size reporting the identical
@@ -22,7 +34,8 @@ What it measures, into ``MULTICHIP_BENCH.json`` (repo root):
     bounded by one shard, from the replica save split each worker runs.
 
 Gates (explicit in the artifact, exit nonzero if any fails):
-  sparse_bytes_5x, parity_ok, spill_parity_ok, replicas_identical,
+  sparse_bytes_5x, int8_coalesced_3x, wire_parity_ok, wire_quality_ok,
+  world1_zero_bytes, parity_ok, spill_parity_ok, replicas_identical,
   ckpt_peak_bounded, weak_efficiency_recorded.
 
 ``--drill`` additionally runs the kill-one-rank supervised drill: a
@@ -110,7 +123,8 @@ def worker_main(args) -> int:
         vector_size=VEC, window=WINDOW, batch_size=BATCH,
         min_count=MIN_COUNT, num_iterations=args.iterations,
         seed=3, steps_per_call=SPC, exchange=args.mode,
-        exchange_capacity=args.capacity,
+        exchange_capacity=args.capacity, exchange_wire=args.wire,
+        exchange_every=args.every,
     ).fit(sentences, checkpoint_dir=ck_dir)
     wall = time.time() - t0
     tm = model.training_metrics
@@ -128,6 +142,8 @@ def worker_main(args) -> int:
         "rank": args.rank,
         "world": args.world,
         "mode": args.mode,
+        "wire": args.wire,
+        "every": args.every,
         "wall_seconds": round(wall, 3),
         "steps": tm["steps"],
         "words_done": tm["words_done"],
@@ -162,7 +178,8 @@ def worker_main(args) -> int:
 
 
 def _run_world(world: int, mode: str, capacity: int,
-               iterations: int) -> list:
+               iterations: int, wire: str = "fp32",
+               every: int = 1) -> list:
     """Launch one weak-scaling run of ``world`` worker processes;
     returns their per-rank result dicts (rank order)."""
     tmp = tempfile.mkdtemp(prefix=f"multichip_w{world}_{mode}_")
@@ -177,6 +194,7 @@ def _run_world(world: int, mode: str, capacity: int,
             "--port", str(port), "--workdir", tmp,
             "--mode", mode, "--capacity", str(capacity),
             "--iterations", str(iterations),
+            "--wire", wire, "--every", str(every),
         ]
         log = open(  # graftlint: ignore[atomic-persist] live subprocess log stream
             os.path.join(tmp, f"rank{r}.log"), "wb"
@@ -232,20 +250,23 @@ def _inprocess_parity(quick: bool) -> dict:
     # cheap while staying honestly inside that regime).
     V, d = (4000, 32) if quick else (12000, 48)
     B = 16  # touched <= B*(1 + C + n) ~ 400 rows << capacity << V
+    ROUNDS = 4  # a multiple of every coalescing factor exercised below
     rng = np.random.default_rng(1)
     counts = rng.integers(1, 1000, V)
 
-    def run(mode, cap):
+    def run(mode, cap, wire="fp32", every=1, topology="flat"):
         engines = [
             EmbeddingEngine(make_mesh(1, 1), V, d, counts, seed=3)
             for _ in range(2)
         ]
         exs = [
-            exmod.ReplicaExchanger(e, mode=mode, capacity=cap)
+            exmod.ReplicaExchanger(e, mode=mode, capacity=cap,
+                                   wire=wire, every=every,
+                                   topology=topology)
             for e in engines
         ]
         key = jax.random.PRNGKey(0)
-        for rnd in range(3):
+        for rnd in range(ROUNDS):
             for r, e in enumerate(engines):
                 rl = np.random.default_rng(50 + 10 * rnd + r)
                 e.train_step(
@@ -254,11 +275,14 @@ def _inprocess_parity(quick: bool) -> dict:
                     np.ones((B, 4), np.float32),
                     jax.random.fold_in(key, 2 * rnd + r), 0.025,
                 )
-            exmod.sync_group(exs)
+            if (rnd + 1) % every == 0:
+                exmod.sync_group(exs)
         t = (np.asarray(engines[0].syn0), np.asarray(engines[0].syn1))
         same = all(
             np.array_equal(np.asarray(engines[0].syn0),
                            np.asarray(e.syn0))
+            and np.array_equal(np.asarray(engines[0].syn1),
+                               np.asarray(e.syn1))
             for e in engines[1:]
         )
         st = engines[0].exchange_stats()
@@ -270,8 +294,46 @@ def _inprocess_parity(quick: bool) -> dict:
     (s0, s1), same_sp, st_sp = run("sparse", cap)
     (d0, d1), same_de, st_de = run("dense", cap)
     (o0, o1), same_ov, st_ov = run("sparse", 16)  # forced spill
+
+    # Wire-variant matrix (ISSUE 16): one capacity for every cell so
+    # the byte ratios are the encoding, not the buffer size. The
+    # coalesced cell accumulates `every` groups of touched rows per
+    # round, so the shared capacity leaves it headroom too.
+    vcap = 1024
+    variants = {}
+    vref = None
+    for name, kw in [
+        ("fp32", {}),
+        ("bf16", dict(wire="bf16")),
+        ("int8", dict(wire="int8")),
+        ("int8_coalesced", dict(wire="int8", every=2)),
+        ("int8_twolevel", dict(wire="int8", topology="twolevel")),
+    ]:
+        t, same, st = run("sparse", vcap, **kw)
+        if vref is None:
+            vref = t
+        drift = max(
+            float(np.max(np.abs(t[0] - vref[0]))),
+            float(np.max(np.abs(t[1] - vref[1]))),
+        )
+        variants[name] = {
+            "replicas_identical": bool(same),
+            "syncs": st["exchange_syncs_total"],
+            "dense_syncs": st["exchange_dense_syncs_total"],
+            "bytes_total": st["exchange_bytes_total"],
+            "bytes_per_sync": st["exchange_bytes_total"]
+            // max(st["exchange_syncs_total"], 1),
+            # normalized per dispatch group: coalescing's win shows up
+            # here (fewer rounds over the same training schedule).
+            "bytes_per_group": st["exchange_bytes_total"] // ROUNDS,
+            "intra_bytes_total": st["exchange_intra_bytes_total"],
+            "inter_bytes_total": st["exchange_inter_bytes_total"],
+            "drift_vs_fp32_max_abs": drift,
+            "residual_abs": st["exchange_residual_abs"],
+        }
     return {
         "vocab": V, "dim": d, "capacity": cap,
+        "variant_capacity": vcap,
         "parity_ok": bool(
             np.array_equal(s0, d0) and np.array_equal(s1, d1)
             and same_sp and same_de
@@ -286,7 +348,61 @@ def _inprocess_parity(quick: bool) -> dict:
         // st_de["exchange_syncs_total"],
         "sparse_rows_total": st_sp["exchange_rows_total"],
         "overflow_spills": st_ov["exchange_overflow_total"],
+        "variants": variants,
     }
+
+
+# The wire encodings must not cost model quality: one fit per wire
+# format over the capital-structure corpus (the same fixture the CI
+# quality legs use), each clearing the vienna/berlin gates.
+WIRE_DRIFT_BOUND = 1e-2
+
+
+def _wire_quality() -> dict:
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    from conftest import _make_tiny_corpus
+    from glint_word2vec_tpu import Word2Vec
+
+    sentences = _make_tiny_corpus()
+    out = {}
+    # world=1: force the loopback wire so the fits actually run the
+    # encode/decode path they are certifying.
+    prev = os.environ.get("GLINT_EXCHANGE_FORCE_WIRE")
+    os.environ["GLINT_EXCHANGE_FORCE_WIRE"] = "1"
+    try:
+        for wire, every in [("fp32", 1), ("bf16", 1), ("int8", 2)]:
+            t0 = time.time()
+            m = Word2Vec(
+                vector_size=VEC, window=WINDOW, batch_size=BATCH,
+                min_count=5, num_iterations=6, seed=1,
+                steps_per_call=SPC, exchange="sparse",
+                exchange_wire=wire, exchange_every=every,
+            ).fit(sentences)
+            syns = m.find_synonyms("austria", 10)
+            words = [w for w, _ in syns]
+            ana = m.analogy(
+                positive=["vienna", "germany"], negative=["austria"],
+                num=10,
+            )
+            vienna = "vienna" in words and dict(syns)["vienna"] > 0.5
+            berlin = "berlin" in [w for w, _ in ana]
+            st = m.training_metrics["exchange"]
+            out[f"{wire}_every{every}"] = {
+                "vienna_gate": bool(vienna),
+                "berlin_gate": bool(berlin),
+                "vienna_sim": round(float(dict(syns).get("vienna", 0)),
+                                    4),
+                "exchange_syncs_total": st["exchange_syncs_total"],
+                "exchange_bytes_total": st["exchange_bytes_total"],
+                "wall_seconds": round(time.time() - t0, 1),
+            }
+            m.stop()
+    finally:
+        if prev is None:
+            os.environ.pop("GLINT_EXCHANGE_FORCE_WIRE", None)
+        else:
+            os.environ["GLINT_EXCHANGE_FORCE_WIRE"] = prev
+    return out
 
 
 def _kill_one_rank_drill(iterations: int) -> dict:
@@ -362,6 +478,11 @@ def main() -> int:
     ap.add_argument("--workdir", default=".")
     ap.add_argument("--mode", default="sparse")
     ap.add_argument("--capacity", type=int, default=0)
+    ap.add_argument("--wire", default="fp32",
+                    choices=["fp32", "bf16", "int8"],
+                    help="delta wire encoding for the sweep workers")
+    ap.add_argument("--every", type=int, default=1,
+                    help="coalesce exchange rounds over N groups")
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--ranks", default="1,2",
                     help="comma list of world sizes for the sweep")
@@ -398,6 +519,7 @@ def main() -> int:
             "steps_per_call": SPC, "iterations": args.iterations,
             "sentences_per_rank": BASE_SENTENCES,
             "vocab_words": VOCAB_WORDS,
+            "sweep_wire": args.wire, "sweep_every": args.every,
         },
         "weak_scaling": [],
     }
@@ -407,12 +529,20 @@ def main() -> int:
     artifact["parity"] = parity
     print(json.dumps(parity, indent=1), flush=True)
 
+    print("== per-wire quality (vienna/berlin) ==", flush=True)
+    quality = _wire_quality()
+    artifact["wire_quality"] = quality
+    print(json.dumps(quality, indent=1), flush=True)
+
     base_wps = None
     replicas_identical = True
     peak_bounded = True
+    world1_bytes_per_sync = None
+    world1_skips = None
     for world in ranks:
         print(f"== weak scaling: world={world} (sparse) ==", flush=True)
-        results = _run_world(world, "sparse", 0, args.iterations)
+        results = _run_world(world, "sparse", 0, args.iterations,
+                             args.wire, args.every)
         fps = {r["table_fingerprint"] for r in results}
         replicas_identical &= len(fps) == 1
         wps_rank = sum(r["words_per_sec"] for r in results) / world
@@ -479,6 +609,12 @@ def main() -> int:
             },
             "per_rank": results,
         }
+        if world == 1:
+            world1_bytes_per_sync = entry["sparse_bytes_per_sync_per_rank"]
+            world1_skips = results[0]["exchange"].get(
+                "exchange_world1_skips_total", 0
+            )
+            entry["world1_skips_total"] = world1_skips
         artifact["weak_scaling"].append(entry)
         print(json.dumps(
             {k: v for k, v in entry.items() if k != "per_rank"},
@@ -493,9 +629,23 @@ def main() -> int:
         print(json.dumps(artifact["kill_one_rank"], indent=1),
               flush=True)
 
+    variants = parity["variants"]
     gates = {
         "sparse_bytes_5x": parity["dense_bytes_per_sync"]
         >= 5 * parity["sparse_bytes_per_sync"],
+        # ISSUE 16: int8 wire + round coalescing moves >= 3x fewer
+        # bytes per dispatch group than fp32 sparse at the same config.
+        "int8_coalesced_3x": variants["fp32"]["bytes_per_group"]
+        >= 3 * variants["int8_coalesced"]["bytes_per_group"],
+        "wire_parity_ok": all(
+            v["replicas_identical"] and v["dense_syncs"] == 0
+            and v["drift_vs_fp32_max_abs"] <= WIRE_DRIFT_BOUND
+            for v in variants.values()
+        ),
+        "wire_quality_ok": all(
+            q["vienna_gate"] and q["berlin_gate"]
+            for q in quality.values()
+        ),
         "parity_ok": parity["parity_ok"],
         "spill_parity_ok": parity["spill_parity_ok"],
         "replicas_identical": replicas_identical,
@@ -505,6 +655,12 @@ def main() -> int:
             for e in artifact["weak_scaling"][1:]
         ),
     }
+    if world1_bytes_per_sync is not None:
+        # one replica never touches the wire: bytes/sync must be 0 and
+        # every round must be counted as a short-circuit skip.
+        gates["world1_zero_bytes"] = (
+            world1_bytes_per_sync == 0 and (world1_skips or 0) > 0
+        )
     if args.drill:
         gates["kill_one_rank_ok"] = artifact["kill_one_rank"]["ok"]
     artifact["gates"] = gates

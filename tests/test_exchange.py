@@ -1,11 +1,31 @@
-"""Sparse touched-row replica exchange (ISSUE 15, parallel/exchange.py).
+"""Sparse touched-row replica exchange (ISSUE 15 + the ISSUE 16 wire
+path: quantized deltas, round coalescing, two-level topology).
 
 The acceptance contracts:
   * sparse and dense exchange schedules produce value-identical final
     tables at matched configs (multi-epoch, subsampled, mid-run resume);
-  * every replica leaves every sync with identical tables;
+  * every replica leaves every sync with identical tables — for every
+    wire format (fp32/bf16/int8), coalescing factor, and topology;
+  * bf16/int8 wire drift vs the fp32 baseline stays bounded; int8
+    error feedback conserves the delta stream exactly (quantized
+    payload + residual carry == true delta);
+  * coalescing is pure schedule: an ``every=R`` run through
+    ``group_end`` is BITWISE-equal to an ``every=1`` run synced
+    manually on the same boundaries (a sync rewrites tables as
+    ``base + (cur - base)``, which is not bitwise ``cur``, so R>1 vs
+    R=1 on *different* boundary schedules is a value-parity question,
+    not a bitwise one — the bench quality legs own that);
   * a capacity overflow spills that round to the dense path and parity
-    still holds;
+    still holds, including under coalescing + int8 (spilled rounds are
+    exact; the carry is not adopted);
+  * mid-run resume under coalescing+int8 is bitwise once the carry is
+    flushed at the checkpoint (the fit loop's pre-checkpoint hook);
+  * world=1 short-circuits the wire (bytes=0, skip counted);
+    GLINT_EXCHANGE_FORCE_WIRE=1 restores the loopback protocol;
+  * unpinned capacity adapts: grows past overflows, shrinks to the
+    observed high-water mark with 2x hysteresis after a full window;
+  * the locality corpus sharder is deterministic, covers the corpus
+    exactly, keeps sentences intact, and clusters rare words;
   * the fit-level wiring (packed + grid) runs the protocol and surfaces
     its telemetry; GLINT_DENSE_EXCHANGE=1 forces dense rounds;
   * heartbeat/Prometheus/gang layers carry the new counters lint-clean.
@@ -58,21 +78,33 @@ def _corpus_shard(rank, world, n_words=4000, seed=9):
 
 
 def _run_replicas(mode, capacity, *, world=2, epochs=2, subsample=False,
-                  resume_after_groups=None, dtype="float32"):
+                  resume_after_groups=None, flush_after_groups=None,
+                  dtype="float32", wire="fp32", every=1, topology="flat",
+                  node_size=None, n_words=4000):
     """Drive ``world`` in-process replicas through the corpus-resident
-    grid scan with one exchange per dispatch group — the fit loop's
-    schedule, minus the estimator plumbing. Optionally snapshot+reload
-    everything after ``resume_after_groups`` groups (mid-run resume).
-    Returns the rank-0 engine (all replicas are asserted identical)."""
+    grid scan with one exchange boundary per ``every`` dispatch groups
+    — the fit loop's schedule, minus the estimator plumbing.
+    ``flush_after_groups`` drains the error-feedback carry at that
+    boundary (the pre-checkpoint hook); ``resume_after_groups``
+    additionally snapshots + reloads everything there (mid-run
+    resume). Returns the rank-0 engine (all replicas are asserted
+    identical)."""
     engines = _engines(world, dtype=dtype)
-    exs = [
-        exmod.ReplicaExchanger(e, mode=mode, capacity=capacity)
-        for e in engines
-    ]
+
+    def _mk(engs):
+        return [
+            exmod.ReplicaExchanger(
+                e, mode=mode, capacity=capacity, wire=wire, every=every,
+                topology=topology, node_size=node_size,
+            )
+            for e in engs
+        ]
+
+    exs = _mk(engines)
     key = jax.random.PRNGKey(5)
     B, W, spc = 64, 3, 2
     for r, e in enumerate(engines):
-        ids, offsets = _corpus_shard(r, world)
+        ids, offsets = _corpus_shard(r, world, n_words=n_words)
         e.upload_corpus(ids, offsets)
         if subsample:
             kp = np.clip(
@@ -102,8 +134,14 @@ def _run_replicas(mode, capacity, *, world=2, epochs=2, subsample=False,
                     jax.random.fold_in(key, 1000 + r), alphas,
                     step0=epoch * groups * spc + g * spc,
                 )
-            exmod.sync_group(exs)
             groups_done += 1
+            boundary = (groups_done % every == 0) or g == groups - 1
+            if boundary:
+                exmod.sync_group(exs)
+            if flush_after_groups is not None \
+                    and groups_done == flush_after_groups:
+                assert boundary, "flush point must be a sync boundary"
+                exmod.flush_group(exs)
             if (
                 resume_after_groups is not None and not resumed
                 and groups_done == resume_after_groups
@@ -120,7 +158,9 @@ def _run_replicas(mode, capacity, *, world=2, epochs=2, subsample=False,
                     fresh = _engines(world, dtype=dtype)
                     for r, e in enumerate(fresh):
                         e.load_tables(path)
-                        ids, offsets = _corpus_shard(r, world)
+                        ids, offsets = _corpus_shard(
+                            r, world, n_words=n_words
+                        )
                         e.upload_corpus(ids, offsets)
                         if subsample:
                             kp = np.clip(
@@ -133,12 +173,7 @@ def _run_replicas(mode, capacity, *, world=2, epochs=2, subsample=False,
                     for old in engines:
                         old.destroy()
                     engines = fresh
-                    exs = [
-                        exmod.ReplicaExchanger(
-                            e, mode=mode, capacity=capacity
-                        )
-                        for e in engines
-                    ]
+                    exs = _mk(engines)
         epoch += 1
     for e in engines[1:]:
         np.testing.assert_array_equal(
@@ -202,6 +237,347 @@ def test_bf16_parity():
         np.testing.assert_array_equal(a, b)
 
 
+def test_wire_matrix_parity_and_bytes():
+    """The (wire, every) matrix: every cell keeps all replicas
+    identical (asserted inside the driver); bf16/int8 drift vs the
+    fp32 baseline stays small; and at a fixed capacity the per-wire
+    byte ordering is int8 < bf16 < fp32 with the bytes attributed to
+    the right per-wire counter bucket."""
+    base = _run_replicas("sparse", 1024, epochs=1, n_words=2500)
+    b16 = _run_replicas("sparse", 1024, epochs=1, n_words=2500,
+                        wire="bf16")
+    i8 = _run_replicas("sparse", 1024, epochs=1, n_words=2500,
+                       wire="int8")
+    ref = _tables(base)
+    for run in (b16, i8):
+        for a, b in zip(_tables(run), ref):
+            assert np.isfinite(a).all()
+            np.testing.assert_allclose(a, b, atol=2e-3, rtol=0)
+    sb, s16, s8 = (e.exchange_stats() for e in (base, b16, i8))
+    assert sb["exchange_syncs_total"] == s16["exchange_syncs_total"] \
+        == s8["exchange_syncs_total"]
+    assert s8["exchange_bytes_total"] < s16["exchange_bytes_total"] \
+        < sb["exchange_bytes_total"]
+    assert sb["exchange_bytes_wire_fp32_total"] == \
+        sb["exchange_bytes_total"]
+    assert s16["exchange_bytes_wire_bf16_total"] == \
+        s16["exchange_bytes_total"]
+    assert s8["exchange_bytes_wire_int8_total"] == \
+        s8["exchange_bytes_total"]
+    assert s8["exchange_dense_syncs_total"] == 0
+    # coalesced cells: fewer boundaries, replicas still identical.
+    c32 = _run_replicas("sparse", 1024, epochs=1, n_words=2500, every=2)
+    c8 = _run_replicas("sparse", 1024, epochs=1, n_words=2500,
+                       wire="int8", every=2)
+    sc32, sc8 = c32.exchange_stats(), c8.exchange_stats()
+    assert sc32["exchange_syncs_total"] < sb["exchange_syncs_total"]
+    assert sc8["exchange_syncs_total"] == sc32["exchange_syncs_total"]
+    for run in (c32, c8):
+        for a in _tables(run):
+            assert np.isfinite(a).all()
+
+
+def test_coalescing_schedule_bitwise(monkeypatch):
+    """Coalescing is pure schedule: ``every=2`` driven through
+    ``group_end`` (window counting, live/done latching) is BITWISE
+    identical to ``every=1`` synced manually on the same boundaries —
+    through the real loopback wire (GLINT_EXCHANGE_FORCE_WIRE)."""
+    monkeypatch.setenv("GLINT_EXCHANGE_FORCE_WIRE", "1")
+    B, W, spc = 64, 3, 2
+
+    def _drive(eng, r):
+        alphas = np.full(spc, 0.02, np.float32)
+        eng.train_steps_corpus(
+            r * spc * B, B, W, jax.random.fold_in(jax.random.PRNGKey(7), r),
+            alphas, step0=r * spc,
+        )
+
+    (e1,) = _engines(1)
+    ids, offsets = _corpus_shard(0, 1)
+    e1.upload_corpus(ids, offsets)
+    xa = exmod.ReplicaExchanger(e1, mode="sparse", capacity=256, every=2)
+    assert not xa.short_circuit
+    for r in range(4):
+        _drive(e1, r)
+        xa.group_end(live=True, done=(r == 3))
+
+    (e2,) = _engines(1)
+    e2.upload_corpus(ids, offsets)
+    xb = exmod.ReplicaExchanger(e2, mode="sparse", capacity=256, every=1)
+    for r in range(4):
+        _drive(e2, r)
+        if (r + 1) % 2 == 0:
+            xb.sync(live=True, done=(r == 3))
+
+    sa, sb = e1.exchange_stats(), e2.exchange_stats()
+    # wire rounds fired only at window boundaries, all 4 groups counted
+    assert sa["exchange_syncs_total"] == 2 == sb["exchange_syncs_total"]
+    assert sa["exchange_groups_total"] == 4
+    np.testing.assert_array_equal(
+        np.asarray(e1.syn0), np.asarray(e2.syn0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e1.syn1), np.asarray(e2.syn1)
+    )
+    e1.destroy()
+    e2.destroy()
+
+
+def test_error_feedback_residual_conservation():
+    """int8 error feedback is a conservation law: on every round,
+    dequantized payload + new carry == true delta + old carry, row for
+    row — nothing the quantizer drops ever leaves the stream. The
+    flush round drains the carry to zero."""
+    (eng,) = _engines(1)
+    ex = exmod.ReplicaExchanger(eng, mode="sparse", capacity=256,
+                                wire="int8")
+    rng = np.random.default_rng(4)
+
+    def _round(old_carry):
+        bases = [np.asarray(t).astype(np.float32)
+                 for t in (eng.syn0, eng.syn1)]
+        eng.train_step(
+            rng.integers(0, V, 16).astype(np.int32),
+            rng.integers(0, V, (16, 4)).astype(np.int32),
+            np.ones((16, 4), np.float32), jax.random.PRNGKey(2), 0.025,
+        )
+        (n0, o0, n1, o1), (i0, p0, s0, i1, p1, s1) = ex.harvest()
+        assert not o0 and not o1 and n0 + n1 > 0
+        curs = [np.asarray(t).astype(np.float32)
+                for t in (eng.syn0, eng.syn1)]
+        for lane, (n, ids, q, sc) in enumerate(
+            [(n0, i0, p0, s0), (n1, i1, p1, s1)]
+        ):
+            if n == 0:
+                continue
+            rows = ids[:n]
+            delta = curs[lane][rows, :D] - bases[lane][rows, :D]
+            deq = q[:n].astype(np.float32) * sc[:n, None]
+            new_carry = np.asarray(ex._pending_carry[lane])[rows]
+            np.testing.assert_allclose(
+                deq + new_carry, delta + old_carry[lane][rows],
+                atol=1e-5, rtol=0,
+            )
+            # round-to-nearest residual bound: |carry| <= scale/2
+            assert np.all(np.abs(q[:n].astype(np.int32)) <= 127)
+            assert np.all(np.abs(new_carry) <= sc[:n, None] * 0.5 + 1e-7)
+
+    zeros = np.zeros((V, D), np.float32)
+    _round((zeros, zeros))
+    # adopt the carry through a real (in-process) round, then check the
+    # conservation holds against the adopted carry on the next round.
+    exmod.sync_group([ex])
+    carried = (np.asarray(ex._carry[0])[:V], np.asarray(ex._carry[1])[:V])
+    assert ex.residual_stats()["residual_abs"] >= float(
+        max(np.max(np.abs(carried[0])), np.max(np.abs(carried[1])))
+    ) > 0.0
+    _round(carried)
+    # flush drains the carry through an exact round and zeroes it.
+    exmod.flush_group([ex])
+    assert ex._carry is None
+    assert ex.residual_stats()["residual_abs"] == 0.0
+    st = eng.exchange_stats()
+    assert st["exchange_flushes_total"] == 1
+    eng.destroy()
+
+
+def test_overflow_spill_coalesced_int8():
+    """Overflow under coalescing + int8: every boundary round spills to
+    the exact dense path (carry never adopted), so the run is BITWISE
+    equal to the dense schedule at the same cadence."""
+    sp = _run_replicas("sparse", 8, epochs=1, n_words=2500,
+                       wire="int8", every=2)
+    de = _run_replicas("dense", 8, epochs=1, n_words=2500,
+                       wire="int8", every=2)
+    for a, b in zip(_tables(sp), _tables(de)):
+        np.testing.assert_array_equal(a, b)
+    st = sp.exchange_stats()
+    assert st["exchange_overflow_total"] > 0
+    assert st["exchange_dense_syncs_total"] == st["exchange_syncs_total"]
+    # spilled rounds ship exact fp32 — bytes land in the fp32 bucket.
+    assert st["exchange_bytes_wire_int8_total"] == 0
+    assert st["exchange_bytes_wire_fp32_total"] == \
+        st["exchange_bytes_total"]
+
+
+def test_midrun_resume_coalesced_int8():
+    """Mid-run resume under coalescing + int8 is bitwise: both the
+    resumed and the uninterrupted run flush the error-feedback carry at
+    the checkpoint boundary (the fit loop's pre-checkpoint hook), so
+    the streams re-converge exactly."""
+    a = _run_replicas("sparse", 1024, epochs=1, n_words=2500,
+                      wire="int8", every=2, resume_after_groups=4,
+                      flush_after_groups=4)
+    b = _run_replicas("sparse", 1024, epochs=1, n_words=2500,
+                      wire="int8", every=2, flush_after_groups=4)
+    for x, y in zip(_tables(a), _tables(b)):
+        np.testing.assert_array_equal(x, y)
+    assert b.exchange_stats()["exchange_flushes_total"] == 1
+
+
+def test_twolevel_topology_parity_and_byte_split():
+    """Two-level sync keeps every replica identical (rank-ordered node
+    fold is deterministic), and attributes bytes to the two hops: the
+    dense intra-node hop dominates, the quantized leaders-only
+    inter-node hop is the small one."""
+    eng = _run_replicas("sparse", 1024, epochs=1, world=4, n_words=2400,
+                        wire="int8", topology="twolevel", node_size=2)
+    st = eng.exchange_stats()
+    assert st["exchange_syncs_total"] > 0
+    assert st["exchange_dense_syncs_total"] == 0
+    assert st["exchange_intra_bytes_total"] > 0
+    assert st["exchange_inter_bytes_total"] > 0
+    assert st["exchange_intra_bytes_total"] + \
+        st["exchange_inter_bytes_total"] == st["exchange_bytes_total"]
+    # rank 0 is a node leader: it ships the quantized node payload on
+    # the slow hop, still smaller than the exact fp32 local hop.
+    assert st["exchange_inter_bytes_total"] < \
+        st["exchange_intra_bytes_total"]
+    for a in _tables(eng):
+        assert np.isfinite(a).all()
+
+
+def test_world1_short_circuit(monkeypatch):
+    """One replica reconciling with itself skips the wire entirely:
+    bytes=0, the skip is counted, flush is a no-op — and the
+    GLINT_EXCHANGE_FORCE_WIRE=1 escape restores the loopback wire for
+    protocol tests."""
+    (eng,) = _engines(1)
+    ex = exmod.ReplicaExchanger(eng, mode="sparse", capacity=64,
+                                wire="int8")
+    assert ex.short_circuit
+    rng = np.random.default_rng(0)
+    eng.train_step(
+        rng.integers(0, V, 16).astype(np.int32),
+        rng.integers(0, V, (16, 4)).astype(np.int32),
+        np.ones((16, 4), np.float32), jax.random.PRNGKey(1), 0.025,
+    )
+    assert ex.sync(live=True, done=False) is True
+    assert ex.sync(live=True, done=True) is False
+    assert ex.flush() is False
+    st = eng.exchange_stats()
+    assert st["exchange_syncs_total"] == 2
+    assert st["exchange_world1_skips_total"] == 2
+    assert st["exchange_bytes_total"] == 0
+    assert st["exchange_flushes_total"] == 0
+    eng.destroy()
+
+    monkeypatch.setenv("GLINT_EXCHANGE_FORCE_WIRE", "1")
+    (e2,) = _engines(1)
+    x2 = exmod.ReplicaExchanger(e2, mode="sparse", capacity=64)
+    assert not x2.short_circuit
+    e2.train_step(
+        rng.integers(0, V, 16).astype(np.int32),
+        rng.integers(0, V, (16, 4)).astype(np.int32),
+        np.ones((16, 4), np.float32), jax.random.PRNGKey(1), 0.025,
+    )
+    x2.sync(live=True)
+    st2 = e2.exchange_stats()
+    assert st2["exchange_bytes_total"] > 0
+    assert st2["exchange_world1_skips_total"] == 0
+    e2.destroy()
+
+
+def test_adaptive_capacity(monkeypatch):
+    """Unpinned capacity walks toward the observed high-water mark:
+    after a full window of small rounds it shrinks (2x headroom,
+    floored), and an overflow immediately grows it past the true
+    touched count. An explicit capacity (or the env pin) disables
+    adaptation."""
+    monkeypatch.setenv("GLINT_EXCHANGE_FORCE_WIRE", "1")
+    monkeypatch.delenv("GLINT_EXCHANGE_CAPACITY", raising=False)
+    rng = np.random.default_rng(0)
+    V2 = 4096
+    eng = EmbeddingEngine(make_mesh(1, 1), V2, 8,
+                          rng.integers(1, 100, V2), seed=3)
+    ex = exmod.ReplicaExchanger(eng, mode="sparse", pair_batch=64,
+                                steps_per_call=4)
+    assert not ex.capacity_pinned
+    start = ex.capacity
+    assert start > exmod.CAPACITY_FLOOR
+    for _ in range(exmod.CAPACITY_WINDOW):
+        eng.train_step(
+            rng.integers(0, 32, 4).astype(np.int32),
+            rng.integers(0, 32, (4, 2)).astype(np.int32),
+            np.ones((4, 2), np.float32), jax.random.PRNGKey(1), 0.01,
+        )
+        ex.sync(live=True)
+    small = ex.capacity
+    assert small < start
+    st = eng.exchange_stats()
+    assert st["exchange_capacity_shrinks_total"] == 1
+    assert st["exchange_capacity"] == small
+    # overflow: touch far more rows than the shrunk capacity.
+    eng.train_step(
+        (np.arange(1024, dtype=np.int32) * 3) % V2,
+        ((np.arange(2048, dtype=np.int32) * 7) % V2).reshape(1024, 2),
+        np.ones((1024, 2), np.float32), jax.random.PRNGKey(2), 0.01,
+    )
+    ex.sync(live=True)
+    assert ex.capacity > small
+    st = eng.exchange_stats()
+    assert st["exchange_capacity_grows_total"] >= 1
+    assert st["exchange_overflow_total"] >= 1
+    eng.destroy()
+
+    # pinned: explicit capacity never adapts.
+    (e2,) = _engines(1)
+    x2 = exmod.ReplicaExchanger(e2, mode="sparse", capacity=64)
+    assert x2.capacity_pinned
+    for _ in range(exmod.CAPACITY_WINDOW + 1):
+        assert x2._adapt_capacity(4, False) is None
+    assert x2.capacity == 64
+    e2.destroy()
+
+
+def test_locality_sharder():
+    """shard_flat_locality: deterministic, covers the corpus word
+    multiset exactly, keeps sentences intact, balances word counts,
+    and orders shards by their rarest-word key (so co-occurring rare
+    words land on the same rank — arXiv:1909.03359's locality split)."""
+    from glint_word2vec_tpu.parallel import distributed as dist
+
+    rng = np.random.default_rng(5)
+    lens = rng.integers(3, 9, 400)
+    ids = rng.integers(0, 500, int(lens.sum())).astype(np.int32)
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    pc = 4
+    shards = [
+        dist.shard_flat_locality(ids, offsets, process_index=pi,
+                                 process_count=pc)
+        for pi in range(pc)
+    ]
+    again = dist.shard_flat_locality(ids, offsets, process_index=2,
+                                     process_count=pc)
+    np.testing.assert_array_equal(shards[2][0], again[0])
+    np.testing.assert_array_equal(shards[2][1], again[1])
+    # exact coverage: the union of the shards is the corpus multiset
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate([s[0] for s in shards])), np.sort(ids)
+    )
+    # balance: every shard within one max sentence of the fair share
+    total = len(ids)
+    for s_ids, s_off in shards:
+        assert s_off[0] == 0 and s_off[-1] == len(s_ids)
+        assert np.all(np.diff(s_off) > 0)
+        assert abs(len(s_ids) - total / pc) <= int(lens.max())
+    # locality: shards are ordered by sentence key (max id = rarest
+    # word under the frequency-sorted vocab); ties may straddle.
+    keys = [
+        np.array([s[0][a:b].max() for a, b in zip(s[1][:-1], s[1][1:])])
+        for s in shards
+    ]
+    for pi in range(pc - 1):
+        assert keys[pi].max() <= keys[pi + 1].min()
+    # world=1 passthrough
+    one_i, one_o = dist.shard_flat_locality(ids, offsets,
+                                            process_index=0,
+                                            process_count=1)
+    np.testing.assert_array_equal(one_i, ids)
+    np.testing.assert_array_equal(one_o, offsets)
+
+
 def test_harvest_exact_touched_rows():
     """The harvest returns exactly the rows whose values changed, each
     once (dedup by construction), with fp32 deltas that reconstruct the
@@ -214,7 +590,7 @@ def test_harvest_exact_touched_rows():
     base0 = np.asarray(eng.syn0)
     eng.train_step(centers, ctx, np.ones((16, 4), np.float32),
                    jax.random.PRNGKey(1), 0.025)
-    (n0, o0, n1, o1), (i0, d0, i1, d1) = ex.harvest()
+    (n0, o0, n1, o1), (i0, d0, _s0, i1, d1, _s1) = ex.harvest()
     cur0 = np.asarray(eng.syn0)
     true_touched = np.where(np.any(cur0 != base0, axis=1))[0]
     got = np.sort(i0[:n0])
@@ -227,8 +603,9 @@ def test_harvest_exact_touched_rows():
 
 def test_fit_level_exchange_and_escape_hatch(monkeypatch):
     """Single-process fit wiring: the exchanger runs every dispatch
-    group, telemetry lands in training_metrics, and the
-    GLINT_DENSE_EXCHANGE=1 escape hatch turns every round dense."""
+    group but short-circuits the world=1 wire (bytes=0, skips counted);
+    with the loopback wire forced, GLINT_DENSE_EXCHANGE=1 turns every
+    round dense."""
     from glint_word2vec_tpu import Word2Vec
 
     rng = np.random.default_rng(11)
@@ -243,12 +620,17 @@ def test_fit_level_exchange_and_escape_hatch(monkeypatch):
     assert m.training_metrics["exchange_mode"] == "sparse"
     assert st["exchange_syncs_total"] > 0
     assert st["exchange_dense_syncs_total"] == 0
+    # world=1 short-circuit: no wire traffic, every round counted
+    assert st["exchange_world1_skips_total"] == st["exchange_syncs_total"]
+    assert st["exchange_bytes_total"] == 0
 
+    monkeypatch.setenv("GLINT_EXCHANGE_FORCE_WIRE", "1")
     monkeypatch.setenv("GLINT_DENSE_EXCHANGE", "1")
     m2 = Word2Vec(**common, exchange="sparse").fit(sents)
     st2 = m2.training_metrics["exchange"]
     assert st2["exchange_syncs_total"] > 0
     assert st2["exchange_dense_syncs_total"] == st2["exchange_syncs_total"]
+    assert st2["exchange_bytes_total"] > 0
     m.stop()
     m2.stop()
 
@@ -270,10 +652,38 @@ def test_fit_level_exchange_grid_path():
     m.stop()
 
 
-def test_exchange_telemetry_through_obs_layers():
-    """Heartbeat snapshot carries the exchange + shard-checkpoint keys,
-    both Prometheus renderers emit them lint-clean, and the gang
-    aggregate sums them across ranks."""
+def test_fit_level_wire_knobs():
+    """The new knobs ride the fit loop end to end: wire/every/topology
+    land in training_metrics and the checkpoint extra, coalescing
+    counts groups past syncs, and the locality sharder is a no-op at
+    world=1."""
+    from glint_word2vec_tpu import Word2Vec
+
+    rng = np.random.default_rng(13)
+    words = [f"w{i}" for i in range(50)]
+    sents = [
+        [str(w) for w in rng.choice(words, size=7)] for _ in range(350)
+    ]
+    m = Word2Vec(
+        vector_size=16, min_count=1, batch_size=128, num_iterations=1,
+        seed=3, steps_per_call=4, exchange="sparse",
+        exchange_wire="int8", exchange_every=2, exchange_shard="locality",
+    ).fit(sents)
+    tm = m.training_metrics
+    assert tm["exchange_wire"] == "int8"
+    assert tm["exchange_every"] == 2
+    assert tm["exchange_topology"] == "flat"
+    st = tm["exchange"]
+    assert st["exchange_syncs_total"] > 0
+    assert st["exchange_groups_total"] >= 2 * st["exchange_syncs_total"]
+    m.stop()
+
+
+def test_exchange_telemetry_through_obs_layers(monkeypatch):
+    """Heartbeat snapshot carries the exchange + shard-checkpoint keys
+    (including the per-wire byte buckets, coalescing counters, capacity
+    gauge and residual), both Prometheus renderers emit them
+    lint-clean, and the gang aggregate sums them across ranks."""
     from glint_word2vec_tpu.obs.aggregate import merge_training_snapshots
     from glint_word2vec_tpu.obs.heartbeat import TrainingStatus
     from glint_word2vec_tpu.obs.prometheus import (
@@ -282,6 +692,7 @@ def test_exchange_telemetry_through_obs_layers():
         training_to_prometheus,
     )
 
+    monkeypatch.setenv("GLINT_EXCHANGE_FORCE_WIRE", "1")
     (eng,) = _engines(1)
     ex = exmod.ReplicaExchanger(eng, mode="sparse", capacity=64)
     rng = np.random.default_rng(0)
@@ -295,17 +706,29 @@ def test_exchange_telemetry_through_obs_layers():
     snap = status.snapshot(include_devices=False)
     assert snap["exchange_syncs_total"] == 1
     assert snap["exchange_bytes_total"] > 0
+    assert snap["exchange_bytes_wire_fp32_total"] == \
+        snap["exchange_bytes_total"]
+    assert snap["exchange_groups_total"] == 1
+    assert snap["exchange_capacity"] == 64
+    assert "exchange_residual_abs" in snap
     assert "checkpoint_shards_skipped" in snap
     text = training_to_prometheus(snap)
     assert not lint_prometheus_text(text)
     assert "glint_training_exchange_bytes_total" in text
+    assert "glint_training_exchange_bytes_wire_int8_total" in text
+    assert "glint_training_exchange_capacity" in text
+    assert "glint_training_exchange_residual_abs" in text
 
     merged = merge_training_snapshots({0: snap, 1: snap})
     assert merged["counters"]["exchange_bytes_total"] == \
         2 * snap["exchange_bytes_total"]
+    assert merged["counters"]["exchange_groups_total"] == 2
     gtext = gang_to_prometheus(merged)
     assert not lint_prometheus_text(gtext)
     assert "glint_gang_exchange_rows_total" in gtext
+    assert "glint_gang_exchange_groups_total" in gtext
+    assert "glint_gang_exchange_intra_bytes_total" in gtext
+    assert "glint_gang_exchange_inter_bytes_total" in gtext
     eng.destroy()
 
 
@@ -316,5 +739,18 @@ def test_exchange_capacity_validation():
         Word2VecParams(exchange="bogus")
     with pytest.raises(ValueError):
         Word2VecParams(exchange_capacity=-1)
-    p = Word2VecParams(exchange="sparse", exchange_capacity=128)
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange_wire="fp64")
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange_every=0)
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange_topology="ring")
+    with pytest.raises(ValueError):
+        Word2VecParams(exchange_shard="hash")
+    p = Word2VecParams(exchange="sparse", exchange_capacity=128,
+                       exchange_wire="int8", exchange_every=4,
+                       exchange_topology="twolevel",
+                       exchange_shard="locality")
     assert p.exchange == "sparse"
+    assert p.exchange_wire == "int8"
+    assert p.exchange_every == 4
