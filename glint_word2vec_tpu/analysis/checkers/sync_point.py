@@ -121,6 +121,11 @@ SYNC_SEAMS: Dict[str, str] = {
         "model query surface: returns host vectors by contract",
     "glint_word2vec_tpu/models/word2vec.py::Word2VecModel.transform_words":
         "model query surface: returns host vectors by contract",
+    "glint_word2vec_tpu/models/word2vec.py::"
+    "Word2VecModel.transform_packed":
+        "bulk-transform hot path (ISSUE 17): harvests one packed "
+        "pull_average block to host vectors by contract — the batch "
+        "pipeline's only device sync",
     "glint_word2vec_tpu/models/word2vec.py::Word2VecModel.get_vectors":
         "model export surface: pulls the table to host by contract",
     "glint_word2vec_tpu/models/word2vec.py::Word2VecModel.to_local":

@@ -1,0 +1,16 @@
+"""Pod-scale batch transform (ISSUE 17): resumable bulk embedding.
+
+The throughput twin of the latency-optimized serving stack — no request
+jitter, no coalescing heuristics, just the "fixed traced shapes, compile
+once, stream forever" discipline applied to offline inference. See
+:mod:`glint_word2vec_tpu.batch.transform`.
+"""
+
+from glint_word2vec_tpu.batch.transform import (  # noqa: F401
+    ShardWriter,
+    count_lines,
+    iter_sentence_lines,
+    load_transform_output,
+    synonyms_dump,
+    transform_file,
+)

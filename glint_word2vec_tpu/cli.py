@@ -567,6 +567,97 @@ def _add_query(sub):
     )
 
     p = sub.add_parser(
+        "transform-file",
+        help="bulk-embed a sentence file into resumable .npy vector "
+             "shards (the offline DataFrame-transform analogue: "
+             "compile-once packed pull-average batches at full device "
+             "utilization)",
+    )
+    p.add_argument("--model", required=True)
+    p.add_argument("--input", required=True,
+                   help="one whitespace-tokenized sentence per line; "
+                        "blank/all-OOV lines become zero vectors — "
+                        "output row i always aligns with input line i")
+    p.add_argument("--out", required=True,
+                   help="shard directory (per-rank rank-NNNN/ subdirs "
+                        "when --workers > 1)")
+    p.add_argument("--rows", type=int, default=1024,
+                   help="sentences per packed device batch — the fixed "
+                        "row bucket of the compiled family "
+                        "(default 1024)")
+    p.add_argument("--max-len", type=int, default=256,
+                   help="token cap per sentence (longer tails are "
+                        "truncated; bounds the warmed pow2 length "
+                        "family, default 256)")
+    p.add_argument("--shard-size", type=int, default=8192,
+                   help="sentences per output shard, rounded up to a "
+                        "--rows multiple (default 8192)")
+    p.add_argument("--lowercase", action="store_true")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="producer batches buffered ahead of the device "
+                        "(default 2: double-buffered)")
+    p.add_argument("--no-deep-verify", action="store_true",
+                   help="resume scan checks shard sizes only instead "
+                        "of re-hashing committed payloads")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the pre-stream compile warmup (steady "
+                        "state then pays the jit compiles)")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the run stats JSON here too (always "
+                        "printed to stdout)")
+    p.add_argument("--status-file", default=None,
+                   help="atomically mirror the transform heartbeat "
+                        "snapshot JSON to this path")
+    p.add_argument("--status-port", type=int, default=None,
+                   help="serve /healthz + /metrics for this transform "
+                        "run (0 binds an ephemeral port)")
+    p.add_argument("--event-log", default=None)
+    p.add_argument("--rank", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--world", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--workers", type=int, default=1,
+                   help="rank-parallel worker count under the elastic "
+                        "supervisor: each rank owns a contiguous input "
+                        "span and a private shard directory, crashed "
+                        "ranks relaunch and resume from their own "
+                        "committed shards")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--heartbeat-stale", type=float, default=120.0,
+                   help="status-file heartbeat age that counts as a "
+                        "hang (0 disables hang detection)")
+    p.add_argument("--startup-grace", type=float, default=600.0)
+    p.add_argument("--supervise-dir", default=None,
+                   help="supervisor status/log directory (default "
+                        "<out>/supervisor)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="merged gang /metrics + /healthz endpoint "
+                        "(supervisor mode; 0 = ephemeral)")
+    p.add_argument("--report-out", default=None,
+                   help="write the supervisor report JSON here too")
+
+    p = sub.add_parser(
+        "synonyms-dump",
+        help="all-vocab top-k neighbor dump (JSONL) and/or k-NN graph "
+             "arrays — whole-table batch top-k, the ANN index's best "
+             "amortization regime",
+    )
+    p.add_argument("--model", required=True)
+    p.add_argument("--out", default=None,
+                   help='JSONL output path (one {"word", "synonyms"} '
+                        "object per vocab word, self-match excluded)")
+    p.add_argument("--graph-out", default=None, metavar="PREFIX",
+                   help="also write <PREFIX>.ids.npy / <PREFIX>.sims"
+                        ".npy / <PREFIX>.json k-NN graph arrays "
+                        "(int32 neighbor ids padded with -1)")
+    p.add_argument("-n", "--num", type=int, default=10)
+    p.add_argument("--block", type=int, default=1024,
+                   help="vocab rows pulled + queried per device "
+                        "dispatch (default 1024)")
+    p.add_argument("--metrics-out", default=None)
+    _add_ann_flags(p)
+
+    p = sub.add_parser(
         "eval", help="analogy accuracy on a standard question file"
     )
     p.add_argument("--model", required=True)
@@ -683,6 +774,137 @@ def _run_supervise(args) -> int:
 
         atomic_write_json(args.report_out, out)
     return 0 if report.completed else 3
+
+
+def _run_transform_fleet(args) -> int:
+    """``transform-file --workers N``: a device-free supervisor shell
+    (the parent never imports jax — the serve-fleet discipline). Each
+    rank re-enters this CLI with ``--rank``/``--world``, derives its
+    contiguous input span, and owns a private ``rank-NNNN/`` shard
+    directory; a crashed or hung rank relaunches and resumes from its
+    own committed shards."""
+    import os
+
+    from glint_word2vec_tpu.parallel.supervisor import (
+        Supervisor,
+        cli_transform_build_argv,
+    )
+
+    rest = [
+        "--model", args.model, "--input", args.input, "--out", args.out,
+        "--rows", str(args.rows), "--max-len", str(args.max_len),
+        "--shard-size", str(args.shard_size),
+        "--prefetch", str(args.prefetch),
+    ]
+    if args.lowercase:
+        rest.append("--lowercase")
+    if args.no_deep_verify:
+        rest.append("--no-deep-verify")
+    if args.no_warmup:
+        rest.append("--no-warmup")
+    sup_dir = args.supervise_dir or os.path.join(args.out, "supervisor")
+    report = Supervisor(
+        cli_transform_build_argv(rest),
+        args.workers,
+        status_dir=sup_dir,
+        heartbeat_stale_seconds=(
+            args.heartbeat_stale if args.heartbeat_stale > 0 else None
+        ),
+        startup_grace_seconds=args.startup_grace,
+        max_restarts=args.max_restarts,
+        metrics_port=args.metrics_port,
+    ).run()
+    out = report.to_dict()
+    print(json.dumps(out))
+    if args.report_out:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.report_out, out)
+    return 0 if report.completed else 3
+
+
+def _run_transform_file(args, model) -> int:
+    """One transform rank (or the whole single-process run): derive the
+    input span, wire the transform heartbeat, stream the file."""
+    import os
+
+    from glint_word2vec_tpu.batch.transform import (
+        count_lines,
+        transform_file,
+    )
+
+    rank = args.rank or 0
+    world = args.world or 1
+    out_dir = args.out
+    start, end = 0, None
+    if world > 1:
+        from glint_word2vec_tpu.parallel.distributed import shard_span
+
+        start, end = shard_span(count_lines(args.input), rank, world)
+        out_dir = os.path.join(args.out, f"rank-{rank:04d}")
+    run = None
+    if (args.status_file or args.status_port is not None
+            or args.event_log):
+        from glint_word2vec_tpu.obs import ObsConfig, start_run
+
+        run = start_run(
+            ObsConfig(
+                status_file=args.status_file,
+                status_port=args.status_port,
+                event_log=args.event_log,
+            ),
+            pipeline="transform", engine=model.engine,
+        )
+    failed = True
+    try:
+        stats = transform_file(
+            model, args.input, out_dir,
+            rows=args.rows, max_len=args.max_len,
+            shard_size=args.shard_size, start=start, end=end,
+            lowercase=args.lowercase, prefetch_depth=args.prefetch,
+            deep_verify=not args.no_deep_verify,
+            warmup=not args.no_warmup, obs_run=run,
+        )
+        failed = False
+    finally:
+        if run is not None:
+            run.close(failed=failed)
+    stats["rank"], stats["world"] = rank, world
+    print(json.dumps(stats))
+    if args.metrics_out:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.metrics_out, stats)
+    return 0
+
+
+def _run_synonyms_dump(args, model) -> int:
+    from glint_word2vec_tpu.batch.transform import synonyms_dump
+
+    if args.out is None and args.graph_out is None:
+        print(
+            "error: synonyms-dump needs --out and/or --graph-out",
+            file=sys.stderr,
+        )
+        return 1
+    if args.ann:
+        eng = model._query_engine()
+        eng.configure_ann(
+            clusters=args.ann_clusters, nprobe=args.ann_nprobe,
+            iters=args.ann_iters, sample=args.ann_sample,
+        )
+        if eng.ann_index is None:
+            eng.adopt_ann(eng.ann_build())
+    stats = synonyms_dump(
+        model, args.out, num=args.num, block=args.block,
+        approximate=args.ann, graph_prefix=args.graph_out,
+    )
+    print(json.dumps(stats))
+    if args.metrics_out:
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(args.metrics_out, stats)
+    return 0
 
 
 def _stream_sentences(path: str, follow: bool, lowercase: bool):
@@ -918,6 +1140,11 @@ def _run(args) -> int:
         # Likewise device-free: the balancer proxies; only the replica
         # SUBPROCESSES load tables.
         return _run_serve_fleet(args)
+    if (args.cmd == "transform-file" and args.workers > 1
+            and args.rank is None):
+        # Rank-parallel bulk transform: the parent is a device-free
+        # supervisor shell; only rank subprocesses load the tables.
+        return _run_transform_fleet(args)
 
     from glint_word2vec_tpu.utils.platform import force_platform
 
@@ -1028,6 +1255,10 @@ def _run(args) -> int:
         return 0
 
     model = load_model(args.model)
+    if args.cmd == "transform-file":
+        return _run_transform_file(args, model)
+    if args.cmd == "synonyms-dump":
+        return _run_synonyms_dump(args, model)
     if args.cmd == "synonyms":
         for w, s in model.find_synonyms(args.word, args.num):
             print(f"{w}\t{s:.4f}")

@@ -99,6 +99,41 @@ def encode_sentences(
     return out
 
 
+def pack_query_block(
+    encoded: Sequence[np.ndarray], rows: Optional[int] = None
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], int]:
+    """Pack encoded sentences into one dense pow2-bucketed ``(rows, len)``
+    index/mask pair — the :meth:`Word2VecModel.transform_sentences`
+    padding factored out for the bulk pipeline
+    (``glint_word2vec_tpu.batch``). ``rows`` fixes the row bucket (the
+    bulk producer packs full fixed-size batches so the compiled family
+    is one row bucket wide); None falls back to ``next_pow2(len(...))``,
+    the serving quantization. Mask-0 padding keeps the device means
+    exact: padded rows come back as zero vectors (sliced off by the
+    caller), padded columns add exact +0.0 terms to each masked mean.
+
+    Returns ``(idx, mask, n)`` where ``n`` is the real row count. A
+    block whose sentences are ALL empty (blank/all-OOV lines) returns
+    ``(None, None, n)`` — nothing to dispatch, every row is the zero
+    vector."""
+    from glint_word2vec_tpu.utils import next_pow2
+
+    n = len(encoded)
+    max_len = max((len(x) for x in encoded), default=0)
+    if max_len == 0:
+        return None, None, n
+    r = int(rows) if rows is not None else next_pow2(n)
+    if n > r:
+        raise ValueError(f"{n} sentences exceed the {r}-row bucket")
+    idx = np.zeros((r, next_pow2(max_len)), np.int32)
+    mask = np.zeros(idx.shape, np.float32)
+    for i, x in enumerate(encoded):
+        if len(x):
+            idx[i, : len(x)] = x
+            mask[i, : len(x)] = 1.0
+    return idx, mask, n
+
+
 def chunk_sentences(
     sentences: Iterable[np.ndarray], max_sentence_length: int
 ) -> List[np.ndarray]:

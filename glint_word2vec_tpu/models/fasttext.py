@@ -224,6 +224,42 @@ class FastTextModel(Word2VecModel):
                 pos += e.size
         return out
 
+    def transform_packed(self, idx: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """Bulk-transform hook on the subword-compose path: the packed
+        word-id block is flattened back to its real tokens (row-major, so
+        the flat order matches :meth:`transform_sentences`' concatenation)
+        and composed in the usual fixed COMPOSE_BLOCK device blocks, then
+        segment-averaged on host. Row results are independent of how the
+        producer batched the stream — each composed word vector is a
+        within-row reduction — so resume/bitwise guarantees carry over."""
+        rows = idx.shape[0]
+        out = np.zeros((rows, self.vector_size), np.float32)
+        lens = mask.astype(bool).sum(axis=1)
+        flat = idx[mask > 0.0].astype(np.int32)
+        if flat.size == 0:
+            return out
+        vecs = self._compose(self._sub_ids[flat], self._sub_mask[flat])
+        pos = 0
+        for i in range(rows):
+            n = int(lens[i])
+            if n:
+                out[i] = vecs[pos : pos + n].mean(axis=0)
+                pos += n
+        return out
+
+    def bulk_warmup(self, rows: int, max_len: int) -> int:
+        """The compose path dispatches only ``(COMPOSE_BLOCK,
+        max_subwords)`` pull-average blocks regardless of the producer's
+        packing (``_compose`` pads every partial block), so ONE shape
+        warms the whole stream — the producer's (rows, len) geometry
+        never reaches the device here."""
+        before = self.engine.query_compiles
+        g = np.zeros(
+            (self.COMPOSE_BLOCK, self.params.max_subwords), np.int32
+        )
+        np.asarray(self._compose_device(g, np.zeros(g.shape, np.float32)))
+        return self.engine.query_compiles - before
+
     # -- similarity over composed vectors ------------------------------
 
     def _query_engine(self):

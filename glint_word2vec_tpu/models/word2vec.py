@@ -1716,6 +1716,36 @@ class Word2VecModel:
             )[: len(block)]
         return out
 
+    def transform_packed(self, idx: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """One pre-packed pow2 ``(rows, len)`` block -> ``(rows, d)`` host
+        means — the bulk-transform hot path (``glint_word2vec_tpu.batch``).
+        The producer owns encoding and padding
+        (:func:`corpus.batching.pack_query_block`); this is exactly the
+        per-chunk ``pull_average`` dispatch of :meth:`transform_sentences`
+        with the packing factored out, so the two paths share the padding
+        exactness contract (mask-0 rows -> zero vectors, mask-0 columns
+        -> exact +0.0 terms). Subword families override with their
+        compose dispatch."""
+        return np.asarray(self.engine.pull_average(idx, mask))
+
+    def bulk_warmup(self, rows: int, max_len: int) -> int:
+        """Compile the whole program family the bulk transform will
+        dispatch — one ``pull_average`` shape per pow2 length bucket up
+        to ``next_pow2(max_len)`` at the fixed ``rows`` bucket — before
+        the stream starts, so steady state pays zero jit compiles
+        (asserted by the pipeline via ``engine.query_compiles``, the
+        serving warmup discipline applied to batch inference). Returns
+        the number of shapes compiled (0 = already warm)."""
+        lens, L = [], 1
+        top = next_pow2(max_len)
+        while L <= top:
+            lens.append(L)
+            L *= 2
+        return self.engine.warmup(
+            q_buckets=(), k_buckets=(),
+            sentence_lens=tuple(lens), sentence_rows=(rows,),
+        )
+
     # ------------------------------------------------------------------
     # Similarity / analogy serving (SURVEY.md §3.3)
     # ------------------------------------------------------------------

@@ -172,6 +172,9 @@ class _NullRun:
     def update_streaming(self, **kw) -> None:
         pass
 
+    def update_transform(self, **kw) -> None:
+        pass
+
     def observe_losses(self, first_step: int, losses, n_real: int) -> None:
         pass
 
@@ -279,6 +282,14 @@ class ObsRun:
         ``TrainingStatus.set_streaming`` and mirrors the status file on
         the usual cadence."""
         self.status.set_streaming(**kw)
+        self._write_status()
+
+    def update_transform(self, **kw) -> None:
+        """Bulk-transform gauge hook (ISSUE 17): forwards to
+        ``TrainingStatus.set_transform`` and mirrors the status file on
+        the usual cadence — the supervisor's liveness sweep reads the
+        same heartbeat it reads for training ranks."""
+        self.status.set_transform(**kw)
         self._write_status()
 
     def observe_losses(self, first_step: int, losses, n_real: int) -> None:

@@ -109,6 +109,7 @@ def merge_training_snapshots(
     # block); None until any rank reports them.
     shard_write_max = None
     shard_verify_max = None
+    transform_ranks: List[dict] = []
     per_rank: Dict[str, dict] = {}
     wps_total = 0.0
     step_means: List[float] = []
@@ -133,6 +134,9 @@ def merge_training_snapshots(
         v = snap.get("checkpoint_shard_verify_seconds")
         if v is not None:
             shard_verify_max = max(shard_verify_max or 0.0, v)
+        tr = snap.get("transform")
+        if tr:
+            transform_ranks.append(tr)
         wps = float(snap.get("words_per_sec_rolling") or 0.0)
         wps_total += wps
         ms = _mean_step_seconds(snap)
@@ -202,7 +206,50 @@ def merge_training_snapshots(
             )
         steptime[p] = entry
 
-    return {
+    # Bulk-transform rollup (ISSUE 17): ranks are embarrassingly
+    # parallel over contiguous input spans, so counters sum, the fill
+    # gauge folds to the WORST (sparsest) rank, and producer wait to
+    # the slowest — the straggler-first policy the checkpoint seconds
+    # above use.
+    transform = None
+    if transform_ranks:
+        fills = [
+            t.get("bucket_fill") for t in transform_ranks
+            if t.get("bucket_fill") is not None
+        ]
+        transform = {
+            "sentences_done_total": sum(
+                int(t.get("sentences_done_total") or 0)
+                for t in transform_ranks
+            ),
+            "input_sentences": sum(
+                int(t.get("input_sentences") or 0)
+                for t in transform_ranks
+            ),
+            "sentences_per_sec_total": round(sum(
+                float(t.get("sentences_per_sec") or 0.0)
+                for t in transform_ranks
+            ), 1),
+            "shards_committed_total": sum(
+                int(t.get("shards_committed_total") or 0)
+                for t in transform_ranks
+            ),
+            "shards_skipped_total": sum(
+                int(t.get("shards_skipped_total") or 0)
+                for t in transform_ranks
+            ),
+            "post_warmup_compiles_total": sum(
+                int(t.get("post_warmup_compiles_total") or 0)
+                for t in transform_ranks
+            ),
+            "bucket_fill_min": min(fills) if fills else None,
+            "producer_wait_seconds_max": max(
+                float(t.get("producer_wait_seconds") or 0.0)
+                for t in transform_ranks
+            ),
+        }
+
+    out = {
         "generation": generation,
         "num_workers": (
             num_workers if num_workers is not None else len(snaps)
@@ -217,6 +264,9 @@ def merge_training_snapshots(
         "per_rank": per_rank,
         "steptime": steptime,
     }
+    if transform is not None:
+        out["transform"] = transform
+    return out
 
 
 def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:

@@ -111,6 +111,10 @@ class TrainingStatus:
         # Streaming gauges (ISSUE 10): None until a fit_stream loop
         # calls set_streaming, so batch fits serve unchanged snapshots.
         self._streaming: Optional[dict] = None
+        # Bulk-transform gauges (ISSUE 17): None until a transform_file
+        # pipeline calls set_transform — training runs serve unchanged
+        # snapshots.
+        self._transform: Optional[dict] = None
         self._last_publish_unix: Optional[float] = None
         # Supervisor handshake (parallel/supervisor.py): echo the launch
         # generation back in every snapshot so the supervisor can tell a
@@ -188,6 +192,33 @@ class TrainingStatus:
                 "buffer_fill": _finite_or_none(buffer_fill),
             }
 
+    def set_transform(self, *, sentences_done=0, input_sentences=0,
+                      sentences_per_sec=0.0, shards_committed=0,
+                      shards_skipped=0, bucket_fill=None,
+                      producer_wait_seconds=0.0, dispatch_seconds=0.0,
+                      post_warmup_compiles=0) -> None:
+        """Install the bulk-transform gauge set (ISSUE 17): stream
+        progress, shard commits/skips, packing density, and the
+        host-stall + compile-freedom health signals — the keys
+        ``training_to_prometheus`` renders as ``glint_transform_*`` and
+        the gang aggregate rolls up across ranks."""
+        with self._mu:
+            # Counters arrive as plain host ints from the pipeline; the
+            # float-ish gauges go through the NaN-safe JSON guard.
+            self._transform = {
+                "sentences_done_total": sentences_done,
+                "input_sentences": input_sentences,
+                "sentences_per_sec": _finite_or_none(sentences_per_sec),
+                "shards_committed_total": shards_committed,
+                "shards_skipped_total": shards_skipped,
+                "bucket_fill": _finite_or_none(bucket_fill),
+                "producer_wait_seconds": _finite_or_none(
+                    producer_wait_seconds
+                ),
+                "dispatch_seconds": _finite_or_none(dispatch_seconds),
+                "post_warmup_compiles_total": post_warmup_compiles,
+            }
+
     def mark_unhealthy(self, reason: str) -> None:
         """Flip the worker to ``unhealthy`` so ``/healthz`` answers 503
         (fleet probes and the supervisor work off status codes, not
@@ -230,6 +261,8 @@ class TrainingStatus:
                     if self._last_publish_unix else None
                 )
                 snap["streaming"] = streaming
+            if self._transform is not None:
+                snap["transform"] = dict(self._transform)
         if m is not None:
             # last_loss is whatever the metrics layer last SYNCED — the
             # heartbeat never forces a device sync of its own.

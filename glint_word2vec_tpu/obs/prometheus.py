@@ -264,6 +264,49 @@ def training_to_prometheus(snap: dict) -> str:
         ]:
             p.head(name, "gauge", help_)
             p.sample(name, None, stream.get(key))
+    transform = snap.get("transform") or {}
+    if transform:
+        # Bulk-transform gauges (ISSUE 17): present only on
+        # transform-file runs — training fits keep their exposition
+        # unchanged.
+        for name, key, help_ in [
+            ("glint_transform_sentences_done_total",
+             "sentences_done_total",
+             "Sentences embedded into committed vector shards "
+             "(resumed prefix included)."),
+            ("glint_transform_shards_committed_total",
+             "shards_committed_total",
+             "Vector shards committed (payload + sidecar manifest + "
+             "progress record) this run."),
+            ("glint_transform_shards_skipped_total",
+             "shards_skipped_total",
+             "Committed shards verified and skipped by the resume "
+             "scan."),
+            ("glint_transform_post_warmup_compiles_total",
+             "post_warmup_compiles_total",
+             "Query-shape compiles after bulk warmup — nonzero means "
+             "the warmed program family missed a steady-state shape."),
+        ]:
+            p.head(name, "counter", help_)
+            p.sample(name, None, transform.get(key, 0))
+        for name, key, help_ in [
+            ("glint_transform_input_sentences", "input_sentences",
+             "Input span size in sentences (lines)."),
+            ("glint_transform_sentences_per_sec", "sentences_per_sec",
+             "Rolling embedded-sentences/sec of this run (resumed "
+             "prefix excluded)."),
+            ("glint_transform_bucket_fill", "bucket_fill",
+             "Real tokens over pow2-padded batch capacity (packing "
+             "density of the dispatched blocks)."),
+            ("glint_transform_producer_wait_seconds",
+             "producer_wait_seconds",
+             "Wall seconds the dispatch loop spent waiting on the "
+             "producer thread (host-stall time)."),
+            ("glint_transform_dispatch_seconds", "dispatch_seconds",
+             "Wall seconds spent in device dispatch + host sync."),
+        ]:
+            p.head(name, "gauge", help_)
+            p.sample(name, None, transform.get(key))
     mem = snap.get("device_memory") or {}
     if mem:
         p.head("glint_device_memory_bytes", "gauge",
@@ -356,6 +399,44 @@ def gang_to_prometheus(snap: dict) -> str:
     ]:
         p.head(name, "counter", help_)
         p.sample(name, None, counters.get(key, 0))
+    transform = snap.get("transform") or {}
+    if transform:
+        # Bulk-transform gang rollup (ISSUE 17): counters summed over
+        # ranks, fill folded to the sparsest rank, producer wait to the
+        # slowest.
+        for name, key, help_ in [
+            ("glint_gang_transform_sentences_done_total",
+             "sentences_done_total",
+             "Sentences embedded into committed shards summed over "
+             "ranks."),
+            ("glint_gang_transform_shards_committed_total",
+             "shards_committed_total",
+             "Vector shards committed summed over ranks."),
+            ("glint_gang_transform_shards_skipped_total",
+             "shards_skipped_total",
+             "Resume-scan shard skips summed over ranks."),
+            ("glint_gang_transform_post_warmup_compiles_total",
+             "post_warmup_compiles_total",
+             "Post-warmup query compiles summed over ranks (any "
+             "nonzero value breaks the compile-once contract)."),
+        ]:
+            p.head(name, "counter", help_)
+            p.sample(name, None, transform.get(key, 0))
+        for name, key, help_ in [
+            ("glint_gang_transform_input_sentences", "input_sentences",
+             "Total input sentences across all rank spans."),
+            ("glint_gang_transform_sentences_per_sec",
+             "sentences_per_sec_total",
+             "Sum of per-rank embedded-sentences/sec."),
+            ("glint_gang_transform_bucket_fill_min", "bucket_fill_min",
+             "Sparsest rank's packing density (real tokens over padded "
+             "capacity)."),
+            ("glint_gang_transform_producer_wait_seconds",
+             "producer_wait_seconds_max",
+             "Slowest rank's host-stall wait on the producer thread."),
+        ]:
+            p.head(name, "gauge", help_)
+            p.sample(name, None, transform.get(key))
     per_rank = snap.get("per_rank") or {}
     p.head("glint_gang_rank_words_per_sec", "gauge",
            "Per-rank rolling trained-words/sec.")

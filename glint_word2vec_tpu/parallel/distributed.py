@@ -240,6 +240,34 @@ def shard_flat_for_process(
     return out_ids, out_offsets
 
 
+def shard_span(
+    n_items: int, process_index: int, process_count: int
+) -> Tuple[int, int]:
+    """Contiguous, balanced ``[start, end)`` span for one rank over
+    ``n_items`` — the bulk-transform input split
+    (``glint_word2vec_tpu.batch``). Unlike
+    :func:`shard_flat_for_process` (round-robin, drop-the-remainder:
+    gradient-path semantics where equal per-rank counts matter more
+    than coverage), this covers EVERY item exactly once: the bulk
+    transform's contract is one output row per input line, so nothing
+    may be dropped. The first ``n_items % process_count`` ranks take
+    one extra item; spans are a pure function of the three arguments,
+    so every rank (and every resume) derives the same split with no
+    coordination."""
+    if process_count < 1:
+        raise ValueError("process_count must be >= 1")
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} out of range for "
+            f"{process_count} processes"
+        )
+    if n_items < 0:
+        raise ValueError("n_items must be >= 0")
+    q, r = divmod(n_items, process_count)
+    start = process_index * q + min(process_index, r)
+    return start, start + q + (1 if process_index < r else 0)
+
+
 def shard_flat_locality(
     ids: np.ndarray,
     offsets: np.ndarray,

@@ -137,6 +137,33 @@ def cli_train_build_argv(train_rest: List[str]) -> BuildArgv:
     return build_argv
 
 
+def cli_transform_build_argv(transform_rest: List[str]) -> BuildArgv:
+    """:data:`BuildArgv` for ranks running ``python -m
+    glint_word2vec_tpu.cli transform-file <transform_rest>`` — the
+    bulk-embedding analogue of :func:`cli_train_build_argv` (ISSUE 17).
+    Ranks are embarrassingly parallel: each derives its contiguous
+    input span from ``--rank``/``--world``
+    (:func:`parallel.distributed.shard_span`) and writes a private
+    ``rank-NNNN/`` shard directory, so no coordinator flags are
+    appended — a relaunched rank resumes from its own committed shards,
+    independent of the others. Supervisor-owned flags come AFTER the
+    operator's args so they win argparse's last-value-wins."""
+    import sys
+
+    def build_argv(rank, n, port, status_file, generation):
+        status_dir = os.path.dirname(status_file)
+        return [
+            sys.executable, "-m", "glint_word2vec_tpu.cli",
+            "transform-file", *transform_rest,
+            "--status-file", status_file,
+            "--metrics-out",
+            os.path.join(status_dir, f"transform-{rank}.json"),
+            "--rank", str(rank), "--world", str(n),
+        ]
+
+    return build_argv
+
+
 @dataclass
 class RestartRecord:
     generation: int  # the generation that FAILED

@@ -84,6 +84,11 @@ POINTS = {
         "just before a replica-exchange round's cross-rank transport",
     "ckpt.shard_commit":
         "after each checkpoint shard block + sidecar manifest write",
+    "transform.producer":
+        "once per packed bulk-transform batch (producer thread)",
+    "transform.shard_commit":
+        "after each bulk-transform vector shard + sidecar manifest "
+        "commit",
 }
 
 _ACTIONS = ("exc", "kill", "hang", "delay")

@@ -156,6 +156,18 @@ def _verify_shard(path: str, fname: str, *, deep: bool) -> None:
         )
 
 
+def verify_shard(path: str, fname: str, *, deep: bool = True) -> None:
+    """Public single-shard verification seam: one shard file against its
+    sidecar manifest, raising :class:`CheckpointCorruptError` on any
+    mismatch. ``deep=False`` checks existence + byte size only (the
+    cheap liveness check); ``deep=True`` re-hashes the payload. Used by
+    the bulk-transform resume scan (``glint_word2vec_tpu.batch``), which
+    trusts exactly the committed-shard prefix that verifies — the same
+    contract the checkpoint restore path applies via
+    :func:`verify_snapshot_dir`."""
+    _verify_shard(path, fname, deep=deep)
+
+
 def verify_snapshot_dir(path: str, *, deep: bool = True) -> bool:
     """Verify a snapshot directory against its manifest.
 

@@ -1,0 +1,338 @@
+"""Transform bench: the ISSUE 17 bulk-embedding pipeline, measured.
+
+Four phases against one tiny trained model and a synthetic sentence
+file (blank and OOV lines mixed in, like real corpora):
+
+  1. **throughput** — in-process ``transform_file`` run: sentences/sec,
+     bucket-fill fraction, host-stall fraction, and the compile-once
+     gate (``post_warmup_compiles == 0``).
+  2. **rank sweep** — REAL ``cli transform-file`` subprocesses at
+     ``--workers`` 1/2/4 (the supervisor shell at >1), each rank owning
+     a contiguous span and private shard dir. Gates: every fleet
+     report ``completed``, zero restarts, and the 4-rank concat output
+     is bitwise identical to the 1-rank run.
+  3. **kill + resume drill** — a run armed with
+     ``GLINT_FAULTS=transform.shard_commit:kill@N`` SIGKILLs itself
+     mid-stream; the bare relaunch resumes from committed shards.
+     Gate: the resumed output's sha256 equals the uninterrupted run's.
+  4. **ANN crossover** — all-vocab bulk top-k timed exact vs
+     approximate across growing query-block sizes Q; records the
+     measured Q where the ANN path first wins (or null if exact wins
+     everywhere at this vocab scale — expected for tiny vocabularies,
+     where the cluster scan overhead dominates).
+
+Everything lands in ``TRANSFORM_BENCH.json`` (exit nonzero on any gate
+failure). Env: GLINT_TRANSFORM_BENCH_OUT overrides the artifact path.
+
+Run:              python scripts/transform_bench.py
+Quick CI gate:    python scripts/transform_bench.py --quick
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GLINT_CKPT_NO_FSYNC", "1")
+
+OUT = os.environ.get(
+    "GLINT_TRANSFORM_BENCH_OUT", os.path.join(ROOT, "TRANSFORM_BENCH.json")
+)
+
+
+def _train_and_save(tmp):
+    from conftest import _make_tiny_corpus
+
+    from glint_word2vec_tpu import Word2Vec
+
+    model = (
+        Word2Vec()
+        .set_vector_size(32).set_window_size(3).set_step_size(0.025)
+        .set_batch_size(256).set_num_negatives(5).set_min_count(5)
+        .set_num_iterations(2).set_seed(1).set_steps_per_call(4)
+    ).fit(_make_tiny_corpus())
+    path = os.path.join(tmp, "model")
+    model.save(path)
+    return model, path
+
+
+def _write_input(tmp, lines_n):
+    """lines_n sentence lines off the tiny-corpus vocabulary, with
+    blank and all-OOV lines mixed in at fixed strides."""
+    from conftest import _make_tiny_corpus
+
+    corpus = _make_tiny_corpus()
+    lines = []
+    for i in range(lines_n):
+        if i % 31 == 0:
+            lines.append("")
+        elif i % 23 == 0:
+            lines.append("zzzunknown qqqmissing xoxoxo")
+        else:
+            lines.append(" ".join(corpus[i % len(corpus)]))
+    from glint_word2vec_tpu.utils import atomic_write_text
+
+    path = os.path.join(tmp, "input.txt")
+    atomic_write_text(path, "\n".join(lines) + "\n")
+    return path, len(lines)
+
+
+def _sha_output(out_dir, world):
+    """sha256 over the concatenated vector bytes, rank dirs in order."""
+    from glint_word2vec_tpu.batch.transform import load_transform_output
+
+    import numpy as np
+
+    if world > 1:
+        parts = [
+            load_transform_output(os.path.join(out_dir, f"rank-{r:04d}"))
+            for r in range(world)
+        ]
+        vecs = np.concatenate(parts)
+    else:
+        vecs = load_transform_output(out_dir)
+    return hashlib.sha256(np.ascontiguousarray(vecs).tobytes()).hexdigest()
+
+
+def _cli(args_list, *, env=None, check=True, timeout=600):
+    cmd = [sys.executable, "-m", "glint_word2vec_tpu.cli", *args_list]
+    proc = subprocess.run(
+        cmd, cwd=ROOT, env=env or dict(os.environ),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if check and proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+        raise RuntimeError(f"cli {args_list[0]} rc={proc.returncode}")
+    return proc
+
+
+def _last_json(text):
+    for ln in reversed(text.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            return json.loads(ln)
+    raise ValueError("no JSON line in output")
+
+
+def phase_throughput(model, inp, tmp, *, rows, max_len, shard_size):
+    from glint_word2vec_tpu.batch.transform import transform_file
+
+    out = os.path.join(tmp, "throughput")
+    stats = transform_file(
+        model, inp, out, rows=rows, max_len=max_len, shard_size=shard_size
+    )
+    return {
+        "sentences": stats["sentences"],
+        "sentences_per_sec": stats["sentences_per_sec"],
+        "bucket_fill": stats["bucket_fill"],
+        "host_stall_frac": stats["host_stall_frac"],
+        "warmup_compiles": stats["warmup_compiles"],
+        "post_warmup_compiles": stats["post_warmup_compiles"],
+        "shards_committed": stats["shards_committed"],
+        "rows": rows, "max_len": max_len, "shard_size": shard_size,
+    }
+
+
+def phase_rank_sweep(model_path, inp, tmp, *, ranks, rows, max_len,
+                     shard_size):
+    cells = []
+    shas = {}
+    for world in ranks:
+        out = os.path.join(tmp, f"sweep-{world}")
+        report_path = os.path.join(tmp, f"report-{world}.json")
+        argv = [
+            "transform-file", "--model", model_path, "--input", inp,
+            "--out", out, "--rows", str(rows),
+            "--max-len", str(max_len), "--shard-size", str(shard_size),
+        ]
+        if world > 1:
+            argv += ["--workers", str(world), "--heartbeat-stale", "0",
+                     "--report-out", report_path]
+        t0 = time.perf_counter()
+        proc = _cli(argv)
+        wall = time.perf_counter() - t0
+        cell = {"workers": world, "wall_seconds": round(wall, 3)}
+        if world > 1:
+            report = json.loads(open(report_path).read())
+            cell["completed"] = report["completed"]
+            cell["restarts"] = report["restarts"]
+            # aggregate rank throughput from the per-rank metrics files
+            per_sec = 0.0
+            sup = os.path.join(out, "supervisor")
+            for r in range(world):
+                m = json.loads(
+                    open(os.path.join(sup, f"transform-{r}.json")).read()
+                )
+                per_sec += m["sentences_per_sec"]
+            cell["sentences_per_sec_total"] = round(per_sec, 1)
+        else:
+            stats = _last_json(proc.stdout)
+            cell["completed"] = True
+            cell["restarts"] = 0
+            cell["sentences_per_sec_total"] = stats["sentences_per_sec"]
+            cell["post_warmup_compiles"] = stats["post_warmup_compiles"]
+        shas[world] = _sha_output(out, world)
+        cells.append(cell)
+    return cells, shas
+
+
+def phase_kill_resume(model_path, inp, tmp, *, rows, max_len, shard_size,
+                      ref_sha, kill_at):
+    out = os.path.join(tmp, "drill")
+    argv = [
+        "transform-file", "--model", model_path, "--input", inp,
+        "--out", out, "--rows", str(rows), "--max-len", str(max_len),
+        "--shard-size", str(shard_size),
+    ]
+    env = dict(os.environ,
+               GLINT_FAULTS=f"transform.shard_commit:kill@{kill_at}")
+    proc = _cli(argv, env=env, check=False)
+    killed = proc.returncode == -9 or proc.returncode == 137
+    committed_before_resume = len(
+        [f for f in os.listdir(out) if f.endswith(".npy")]
+    ) if os.path.isdir(out) else 0
+    t0 = time.perf_counter()
+    resume = _last_json(_cli(argv).stdout)
+    resume_wall = time.perf_counter() - t0
+    return {
+        "kill_at_shard": kill_at,
+        "killed_rc": proc.returncode,
+        "sigkill_observed": killed,
+        "shards_committed_before_resume": committed_before_resume,
+        "resume_shards_skipped": resume["shards_skipped"],
+        "resume_sentences_resumed": resume["resumed_sentences"],
+        "resume_wall_seconds": round(resume_wall, 3),
+        "resume_sha256": _sha_output(out, 1),
+        "uninterrupted_sha256": ref_sha,
+        "resume_bitwise_identical": _sha_output(out, 1) == ref_sha,
+    }
+
+
+def phase_ann_crossover(model, *, q_sizes, num):
+    """Bulk top-k timed exact vs ANN across query-block sizes drawn
+    from the model's own table (the synonyms-dump shape)."""
+    import numpy as np
+
+    eng = model._query_engine()
+    eng.configure_ann(clusters=16, nprobe=4, iters=5, sample=2048)
+    if eng.ann_index is None:
+        eng.adopt_ann(eng.ann_build())
+    V = model.vocab.size
+    cells = []
+    crossover = None
+    for q in q_sizes:
+        ids = np.arange(q, dtype=np.int32) % V
+        vecs = np.asarray(eng.pull(ids))
+        t0 = time.perf_counter()
+        model.find_synonyms_batch(vecs, num, approximate=False)
+        exact_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        model.find_synonyms_batch(vecs, num, approximate=True)
+        ann_s = time.perf_counter() - t0
+        cells.append({
+            "q": q,
+            "exact_seconds": round(exact_s, 4),
+            "ann_seconds": round(ann_s, 4),
+            "ann_speedup": round(exact_s / ann_s, 2) if ann_s else None,
+        })
+        if crossover is None and ann_s < exact_s:
+            crossover = q
+    return {
+        "vocab": V, "num": num, "clusters": 16, "nprobe": 4,
+        "cells": cells,
+        "crossover_q": crossover,
+        "note": (
+            "crossover_q is the smallest measured Q where the ANN bulk "
+            "path beats exact; null means exact won at every measured Q "
+            "(tiny-vocab regime — the cluster scan overhead dominates "
+            "until V or Q grows)"
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized corpus and 1/2-rank sweep only")
+    args = ap.parse_args()
+
+    quick = args.quick
+    lines_n = 400 if quick else 2000
+    ranks = (1, 2) if quick else (1, 2, 4)
+    rows, max_len, shard_size = 64, 32, 128
+    q_sizes = (32, 128) if quick else (32, 128, 512, 2048)
+
+    tmp = tempfile.mkdtemp(prefix="transform_bench_")
+    t_start = time.perf_counter()
+    try:
+        model, model_path = _train_and_save(tmp)
+        inp, lines_n = _write_input(tmp, lines_n)
+
+        print("phase 1: throughput", file=sys.stderr)
+        throughput = phase_throughput(
+            model, inp, tmp, rows=rows, max_len=max_len,
+            shard_size=shard_size,
+        )
+
+        print("phase 2: rank sweep", file=sys.stderr)
+        sweep, shas = phase_rank_sweep(
+            model_path, inp, tmp, rows=rows, max_len=max_len,
+            shard_size=shard_size, ranks=ranks,
+        )
+
+        print("phase 3: kill+resume drill", file=sys.stderr)
+        drill = phase_kill_resume(
+            model_path, inp, tmp, rows=rows, max_len=max_len,
+            shard_size=shard_size, ref_sha=shas[1], kill_at=2,
+        )
+
+        print("phase 4: ann crossover", file=sys.stderr)
+        ann = phase_ann_crossover(model, q_sizes=q_sizes, num=10)
+        model.stop()
+
+        gates = {
+            "zero_post_warmup_compiles":
+                throughput["post_warmup_compiles"] == 0,
+            "all_fleets_completed":
+                all(c["completed"] for c in sweep),
+            "zero_restarts": all(c["restarts"] == 0 for c in sweep),
+            "rank_outputs_bitwise_identical":
+                len(set(shas.values())) == 1,
+            "sigkill_observed": drill["sigkill_observed"],
+            "resume_skipped_committed_shards":
+                drill["resume_shards_skipped"] >= 1,
+            "resume_bitwise_identical":
+                drill["resume_bitwise_identical"],
+        }
+        out = {
+            "bench": "transform",
+            "quick": quick,
+            "input_lines": lines_n,
+            "throughput": throughput,
+            "rank_sweep": sweep,
+            "kill_resume_drill": drill,
+            "ann_crossover": ann,
+            "gates": gates,
+            "wall_seconds": round(time.perf_counter() - t_start, 1),
+        }
+        from glint_word2vec_tpu.utils import atomic_write_json
+
+        atomic_write_json(OUT, out, indent=2)
+        print(json.dumps({"gates": gates, "out": OUT}, indent=2))
+        return 0 if all(gates.values()) else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,502 @@
+"""Pod-scale batch transform (ISSUE 17): the resumable bulk-embedding
+pipeline — packing, bitwise parity with ``transform_sentences``,
+kill/corruption resume, contiguous rank spans, the ``MAX_QUERY_ROWS``
+chunking parity satellite, the fastText compose path with a host-NumPy
+oracle, ANN dump jobs, and the transform observability block."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.batch.transform import (
+    ShardWriter,
+    count_lines,
+    iter_sentence_lines,
+    load_transform_output,
+    synonyms_dump,
+    transform_file,
+)
+from glint_word2vec_tpu.corpus.batching import pack_query_block
+from glint_word2vec_tpu.parallel.distributed import shard_span
+from glint_word2vec_tpu.utils import faults
+from glint_word2vec_tpu.utils.integrity import CheckpointCorruptError
+
+
+# ----------------------------------------------------------------------
+# Host-side building blocks
+# ----------------------------------------------------------------------
+
+
+def test_pack_query_block_pow2_shapes_and_mask():
+    enc = [np.array([3, 1, 4], np.int32), np.array([], np.int32),
+           np.array([1, 5], np.int32)]
+    idx, mask, n = pack_query_block(enc, rows=8)
+    assert n == 3
+    assert idx.shape == (8, 4) and mask.shape == (8, 4)
+    assert idx.dtype == np.int32 and mask.dtype == np.float32
+    np.testing.assert_array_equal(idx[0, :3], [3, 1, 4])
+    np.testing.assert_array_equal(mask[0], [1, 1, 1, 0])
+    np.testing.assert_array_equal(mask[1], [0, 0, 0, 0])
+    np.testing.assert_array_equal(mask[2], [1, 1, 0, 0])
+    assert mask[3:].sum() == 0
+
+
+def test_pack_query_block_all_empty_and_overflow():
+    idx, mask, n = pack_query_block(
+        [np.array([], np.int32)] * 3, rows=4
+    )
+    assert idx is None and mask is None and n == 3
+    with pytest.raises(ValueError):
+        pack_query_block([np.array([1], np.int32)] * 5, rows=4)
+
+
+def test_pack_query_block_default_rows_quantize():
+    enc = [np.array([1], np.int32)] * 3
+    idx, _, n = pack_query_block(enc)
+    assert idx.shape[0] == 4 and n == 3
+
+
+def test_shard_span_covers_everything_contiguously():
+    for total in (0, 1, 7, 8, 9, 100):
+        for world in (1, 2, 3, 4, 7):
+            spans = [shard_span(total, r, world) for r in range(world)]
+            # contiguous, ordered, full coverage, balanced within 1
+            assert spans[0][0] == 0 and spans[-1][1] == total
+            for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+                assert e0 == s1
+            sizes = [e - s for s, e in spans]
+            assert max(sizes) - min(sizes) <= 1
+    with pytest.raises(ValueError):
+        shard_span(10, 2, 2)
+    with pytest.raises(ValueError):
+        shard_span(10, 0, 0)
+
+
+def test_count_lines_and_line_iterator(tmp_path):
+    p = tmp_path / "in.txt"
+    p.write_text("a b\n\nc\n")
+    assert count_lines(str(p)) == 3
+    # trailing line without newline still counts
+    p2 = tmp_path / "in2.txt"
+    p2.write_text("a\nb")
+    assert count_lines(str(p2)) == 2
+    # blank lines are PRESERVED (row i == line i), unlike iter_text_file
+    sents = list(iter_sentence_lines(str(p)))
+    assert sents == [["a", "b"], [], ["c"]]
+    assert list(iter_sentence_lines(str(p), start=1, end=2)) == [[]]
+
+
+# ----------------------------------------------------------------------
+# The pipeline against the e2e model
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def transform_input(tmp_path_factory, tiny_corpus):
+    """~90 input lines riding the session corpus: real sentences mixed
+    with blank lines and all-OOV lines (both must become zero vectors
+    without shifting row alignment)."""
+    lines = []
+    for i in range(90):
+        if i % 17 == 0:
+            lines.append("")
+        elif i % 13 == 0:
+            lines.append("zzzunknown qqqmissing")
+        else:
+            lines.append(" ".join(tiny_corpus[i % len(tiny_corpus)]))
+    path = tmp_path_factory.mktemp("transform") / "input.txt"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path), [line.split() for line in lines]
+
+
+def test_transform_file_bitwise_vs_transform_sentences(
+    e2e_model, transform_input, tmp_path
+):
+    path, sents = transform_input
+    out = str(tmp_path / "out")
+    stats = transform_file(
+        e2e_model, path, out, rows=8, max_len=16, shard_size=16
+    )
+    vecs = load_transform_output(out)
+    ref = e2e_model.transform_sentences(sents)
+    np.testing.assert_array_equal(vecs, ref)
+    assert stats["sentences"] == stats["sentences_done"] == len(sents)
+    # the compile-once contract: the warmed family covers steady state
+    assert stats["post_warmup_compiles"] == 0
+    assert stats["shards_committed"] == -(-len(sents) // 16)
+    assert 0.0 < stats["bucket_fill"] <= 1.0
+    # progress record marks completion
+    prog = json.loads(
+        (tmp_path / "out" / "progress.json").read_text()
+    )
+    assert prog["complete"] and prog["sentences_done"] == len(sents)
+
+
+def test_transform_file_resume_after_fault_is_bitwise(
+    e2e_model, transform_input, tmp_path
+):
+    path, sents = transform_input
+    ref_dir = str(tmp_path / "ref")
+    transform_file(e2e_model, path, ref_dir, rows=8, max_len=16,
+                   shard_size=16)
+    out = str(tmp_path / "out")
+    faults.arm("transform.shard_commit:exc@2")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            transform_file(e2e_model, path, out, rows=8, max_len=16,
+                           shard_size=16)
+    finally:
+        faults.disarm()
+    # the interrupted run left a committed prefix behind
+    assert os.path.exists(os.path.join(out, "shard-000001.npy"))
+    stats = transform_file(e2e_model, path, out, rows=8, max_len=16,
+                           shard_size=16)
+    assert stats["shards_skipped"] >= 2
+    assert stats["resumed_sentences"] >= 32
+    np.testing.assert_array_equal(
+        load_transform_output(out), load_transform_output(ref_dir)
+    )
+
+
+def test_transform_file_corrupt_shard_recomputed(
+    e2e_model, transform_input, tmp_path
+):
+    path, _ = transform_input
+    out = str(tmp_path / "out")
+    transform_file(e2e_model, path, out, rows=8, max_len=16,
+                   shard_size=16)
+    ref = load_transform_output(out)
+    # bit-rot the middle shard: same size, different bytes — only the
+    # deep sha verify can catch it
+    victim = os.path.join(out, "shard-000001.npy")
+    raw = bytearray(open(victim, "rb").read())
+    raw[-1] ^= 0xFF
+    open(victim, "wb").write(raw)
+    stats = transform_file(e2e_model, path, out, rows=8, max_len=16,
+                           shard_size=16)
+    # resume trusted exactly one shard, recomputed from there
+    assert stats["shards_skipped"] == 1
+    np.testing.assert_array_equal(load_transform_output(out), ref)
+
+
+def test_transform_file_geometry_mismatch_refuses(
+    e2e_model, transform_input, tmp_path
+):
+    path, _ = transform_input
+    out = str(tmp_path / "out")
+    transform_file(e2e_model, path, out, rows=8, max_len=16,
+                   shard_size=16)
+    with pytest.raises(CheckpointCorruptError):
+        transform_file(e2e_model, path, out, rows=16, max_len=16,
+                       shard_size=16)
+
+
+def test_transform_file_rank_spans_concat_bitwise(
+    e2e_model, transform_input, tmp_path
+):
+    path, sents = transform_input
+    ref = e2e_model.transform_sentences(sents)
+    parts = []
+    for rank in range(3):
+        start, end = shard_span(len(sents), rank, 3)
+        out = str(tmp_path / f"rank-{rank}")
+        transform_file(e2e_model, path, out, rows=8, max_len=16,
+                       shard_size=16, start=start, end=end)
+        parts.append(load_transform_output(out))
+    np.testing.assert_array_equal(np.concatenate(parts), ref)
+
+
+def test_shard_writer_commit_fires_fault_point(tmp_path):
+    w = ShardWriter(str(tmp_path / "w"), shard_size=4, dim=3,
+                    meta={"version": 1})
+    faults.arm("transform.shard_commit:exc@1")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            w.append(np.ones((4, 3), np.float32))
+    finally:
+        faults.disarm()
+    # the shard itself committed before the fault point
+    assert os.path.exists(str(tmp_path / "w" / "shard-000000.npy"))
+
+
+# ----------------------------------------------------------------------
+# Satellite: MAX_QUERY_ROWS chunked-vs-unchunked parity
+# ----------------------------------------------------------------------
+
+
+def test_transform_sentences_chunked_parity(
+    e2e_model, tiny_corpus, monkeypatch
+):
+    """The serving ``/transform`` path chunks at MAX_QUERY_ROWS; the
+    chunked result must be bit-for-bit the unchunked one (pow2 padding
+    adds exact +0.0 terms only)."""
+    from glint_word2vec_tpu.models import word2vec as w2v_mod
+
+    sents = [tiny_corpus[i % len(tiny_corpus)] for i in range(20)]
+    whole = e2e_model.transform_sentences(sents)
+    monkeypatch.setattr(w2v_mod, "MAX_QUERY_ROWS", 8)
+    chunked = e2e_model.transform_sentences(sents)
+    np.testing.assert_array_equal(chunked, whole)
+
+
+def test_transform_packed_matches_transform_sentences(
+    e2e_model, tiny_corpus
+):
+    sents = tiny_corpus[:10]
+    enc = [e2e_model.vocab.encode(s) for s in sents]
+    idx, mask, n = pack_query_block(enc, rows=16)
+    packed = e2e_model.transform_packed(idx, mask)[:n]
+    np.testing.assert_array_equal(
+        packed, e2e_model.transform_sentences(sents)
+    )
+
+
+# ----------------------------------------------------------------------
+# Satellite: fastText subword-compose path + host-NumPy oracle
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ft_model(tiny_corpus):
+    from glint_word2vec_tpu import FastTextWord2Vec
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    ft = FastTextWord2Vec(
+        mesh=make_mesh(2, 4), vector_size=16, min_count=5,
+        batch_size=256, num_iterations=1, step_size=0.025, seed=1,
+        bucket=2000,
+    )
+    model = ft.fit(tiny_corpus)
+    yield model
+    model.stop()
+
+
+def test_fasttext_bulk_transform_oov_heavy(
+    ft_model, transform_input, tmp_path
+):
+    path, sents = transform_input
+    out = str(tmp_path / "ft")
+    stats = transform_file(ft_model, path, out, rows=8, max_len=16,
+                           shard_size=16)
+    vecs = load_transform_output(out)
+    np.testing.assert_array_equal(
+        vecs, ft_model.transform_sentences(sents)
+    )
+    # compose dispatches only the one warmed (COMPOSE_BLOCK,
+    # max_subwords) shape, independent of the producer's packing
+    assert stats["post_warmup_compiles"] == 0
+
+
+def test_fasttext_transform_packed_numpy_oracle(ft_model, tiny_corpus):
+    """Host-NumPy oracle: pull the needed subword rows once, compose
+    each word as the mean of its subword vectors, each sentence as the
+    mean of its word vectors — the packed device path must agree."""
+    sents = tiny_corpus[:6] + [["zzzunknown"], []]
+    enc = [ft_model.vocab.encode(s) for s in sents]
+    idx, mask, n = pack_query_block(enc, rows=8)
+    got = ft_model.transform_packed(idx, mask)[:n]
+    oracle = np.zeros((len(sents), ft_model.vector_size), np.float32)
+    for i, ids in enumerate(enc):
+        if not len(ids):
+            continue
+        wvecs = []
+        for wid in ids:
+            g = ft_model._sub_ids[wid]
+            m = ft_model._sub_mask[wid] > 0
+            rows = np.asarray(ft_model.engine.pull(g[m]))
+            wvecs.append(rows.mean(axis=0))
+        oracle[i] = np.mean(wvecs, axis=0)
+    np.testing.assert_allclose(got, oracle, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# ANN batch jobs
+# ----------------------------------------------------------------------
+
+
+def test_synonyms_dump_jsonl_and_graph(e2e_model, tmp_path):
+    out = str(tmp_path / "syn.jsonl")
+    prefix = str(tmp_path / "knn")
+    stats = synonyms_dump(
+        e2e_model, out, num=5, block=32, graph_prefix=prefix
+    )
+    assert stats["words"] == e2e_model.vocab.size
+    lines = [json.loads(x) for x in open(out)]
+    assert len(lines) == e2e_model.vocab.size
+    by_word = {d["word"]: d["synonyms"] for d in lines}
+    word = e2e_model.vocab.words[0]
+    expect = e2e_model.find_synonyms(word, 5)
+    assert [w for w, _ in by_word[word]] == [w for w, _ in expect]
+    # self-match is excluded everywhere
+    assert all(
+        d["word"] not in [w for w, _ in d["synonyms"]] for d in lines
+    )
+    ids = np.load(prefix + ".ids.npy")
+    sims = np.load(prefix + ".sims.npy")
+    V = e2e_model.vocab.size
+    assert ids.shape == (V, 5) and ids.dtype == np.int32
+    assert sims.shape == (V, 5) and sims.dtype == np.float32
+    assert all(ids[i, 0] != i for i in range(V))
+    meta = json.loads(open(prefix + ".json").read())
+    assert meta["pad_id"] == -1 and meta["words"] == V
+
+
+def test_synonyms_dump_vocab_span(e2e_model, tmp_path):
+    out = str(tmp_path / "span.jsonl")
+    stats = synonyms_dump(e2e_model, out, num=3, block=8, start=2, end=6)
+    assert stats["words"] == 4
+    words = [json.loads(x)["word"] for x in open(out)]
+    assert words == list(e2e_model.vocab.words[2:6])
+
+
+# ----------------------------------------------------------------------
+# Observability: heartbeat block, renderers, gang rollup
+# ----------------------------------------------------------------------
+
+
+def _transform_kwargs(done=64, rank_scale=1):
+    return dict(
+        sentences_done=done, input_sentences=128,
+        sentences_per_sec=100.0 * rank_scale, shards_committed=4,
+        shards_skipped=1, bucket_fill=0.75,
+        producer_wait_seconds=0.5 * rank_scale, dispatch_seconds=2.0,
+        post_warmup_compiles=0,
+    )
+
+
+def test_heartbeat_transform_block_and_prometheus():
+    from glint_word2vec_tpu.obs.heartbeat import TrainingStatus
+    from glint_word2vec_tpu.obs.prometheus import (
+        lint_prometheus_text,
+        training_to_prometheus,
+    )
+
+    st = TrainingStatus(pipeline="transform")
+    snap = st.snapshot(include_devices=False)
+    assert "transform" not in snap  # None until set, like streaming
+    st.set_transform(**_transform_kwargs())
+    snap = st.snapshot(include_devices=False)
+    tr = snap["transform"]
+    assert tr["sentences_done_total"] == 64
+    assert tr["shards_skipped_total"] == 1
+    assert tr["bucket_fill"] == 0.75
+    text = training_to_prometheus(snap)
+    lint_prometheus_text(text)
+    for name in (
+        "glint_transform_sentences_done_total",
+        "glint_transform_shards_committed_total",
+        "glint_transform_post_warmup_compiles_total",
+        "glint_transform_bucket_fill",
+        "glint_transform_producer_wait_seconds",
+    ):
+        assert name in text
+    # training snapshots without the block keep their exposition clean
+    plain = training_to_prometheus(
+        TrainingStatus(pipeline="fit").snapshot(include_devices=False)
+    )
+    assert "glint_transform_" not in plain
+
+
+def test_gang_rollup_sums_and_folds():
+    from glint_word2vec_tpu.obs.aggregate import merge_training_snapshots
+    from glint_word2vec_tpu.obs.heartbeat import TrainingStatus
+    from glint_word2vec_tpu.obs.prometheus import (
+        gang_to_prometheus,
+        lint_prometheus_text,
+    )
+
+    snaps = {}
+    for rank in (0, 1):
+        st = TrainingStatus(pipeline="transform")
+        st.set_transform(**_transform_kwargs(
+            done=64 * (rank + 1), rank_scale=rank + 1
+        ))
+        snaps[rank] = st.snapshot(include_devices=False)
+    merged = merge_training_snapshots(snaps, num_workers=2)
+    tr = merged["transform"]
+    assert tr["sentences_done_total"] == 64 + 128
+    assert tr["input_sentences"] == 256
+    assert tr["sentences_per_sec_total"] == 300.0
+    assert tr["shards_committed_total"] == 8
+    assert tr["bucket_fill_min"] == 0.75
+    assert tr["producer_wait_seconds_max"] == 1.0
+    text = gang_to_prometheus(merged)
+    lint_prometheus_text(text)
+    assert "glint_gang_transform_sentences_done_total" in text
+    # gangs without transform ranks stay unchanged
+    st = TrainingStatus(pipeline="fit")
+    merged_plain = merge_training_snapshots(
+        {0: st.snapshot(include_devices=False)}, num_workers=1
+    )
+    assert "transform" not in merged_plain
+    assert "glint_gang_transform_" not in gang_to_prometheus(merged_plain)
+
+
+def test_obs_run_update_transform_writes_status(tmp_path):
+    from glint_word2vec_tpu.obs import NULL_RUN, ObsConfig, start_run
+
+    # the null run accepts the hook
+    NULL_RUN.update_transform(**_transform_kwargs())
+    status = str(tmp_path / "status.json")
+    run = start_run(ObsConfig(status_file=status), pipeline="transform")
+    try:
+        run.update_transform(**_transform_kwargs())
+    finally:
+        run.close()
+    snap = json.loads(open(status).read())
+    assert snap["transform"]["sentences_done_total"] == 64
+    assert snap["pipeline"] == "transform"
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def saved_model_dir(e2e_model, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("saved") / "model")
+    e2e_model.save(path)
+    return path
+
+
+def test_cli_transform_file_and_resume(
+    saved_model_dir, transform_input, tmp_path, capsys
+):
+    from glint_word2vec_tpu import cli
+
+    path, sents = transform_input
+    out = str(tmp_path / "out")
+    argv = [
+        "transform-file", "--model", saved_model_dir, "--input", path,
+        "--out", out, "--rows", "8", "--max-len", "16",
+        "--shard-size", "16",
+    ]
+    assert cli.main(argv) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["sentences_done"] == len(sents)
+    assert stats["post_warmup_compiles"] == 0
+    # a second invocation is a no-op resume: everything skipped
+    assert cli.main(argv) == 0
+    stats2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats2["shards_committed"] == 0
+    assert stats2["shards_skipped"] == stats["shards_committed"]
+    vecs = load_transform_output(out)
+    assert vecs.shape == (len(sents), 48)
+
+
+def test_cli_synonyms_dump(saved_model_dir, tmp_path, capsys):
+    from glint_word2vec_tpu import cli
+
+    out = str(tmp_path / "syn.jsonl")
+    rc = cli.main([
+        "synonyms-dump", "--model", saved_model_dir, "--out", out,
+        "-n", "3", "--block", "32",
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["words"] == sum(1 for _ in open(out))
+    # requires at least one output target
+    assert cli.main(["synonyms-dump", "--model", saved_model_dir]) == 1
