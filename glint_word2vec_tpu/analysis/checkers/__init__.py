@@ -6,6 +6,7 @@ from glint_word2vec_tpu.analysis.checkers import (  # noqa: F401
     fault_points,
     lock_discipline,
     prometheus,
+    span_registry,
     sync_point,
     table_mutation,
 )

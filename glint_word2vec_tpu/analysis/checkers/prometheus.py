@@ -47,16 +47,19 @@ PRODUCERS: Dict[str, Tuple[str, ...]] = {
         "glint_word2vec_tpu/obs/events.py",
         "glint_word2vec_tpu/obs/canary.py",
         "glint_word2vec_tpu/parallel/engine.py",
+        "glint_word2vec_tpu/obs/slo.py",
     ),
     "serving_to_prometheus": (
         "glint_word2vec_tpu/utils/metrics.py",
         "glint_word2vec_tpu/serving.py",
         "glint_word2vec_tpu/parallel/engine.py",
+        "glint_word2vec_tpu/obs/slo.py",
     ),
     "gang_to_prometheus": (
         "glint_word2vec_tpu/obs/aggregate.py",
         "glint_word2vec_tpu/obs/heartbeat.py",
         "glint_word2vec_tpu/utils/metrics.py",
+        "glint_word2vec_tpu/obs/slo.py",
     ),
     "fleet_to_prometheus": (
         "glint_word2vec_tpu/fleet.py",

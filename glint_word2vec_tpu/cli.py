@@ -368,6 +368,15 @@ def _add_query(sub):
                         "the server is warmed and listening (the "
                         "fleet launcher's readiness barrier for "
                         "--port 0)")
+    p.add_argument("--trace-log", default=None, metavar="FILE",
+                   help="record request-path phase spans (tail-sampled "
+                        "distributed tracing) to this JSONL sink; "
+                        "stitch per-process sinks with `cli "
+                        "trace-merge` and open the result in Perfetto")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the anomaly flight recorder: a shed burst "
+                        "or an SLO fast-burn writes a postmortem "
+                        "bundle (recent spans + full metrics) here")
     _add_ann_flags(p)
     over = p.add_argument_group(
         "overload protection",
@@ -419,6 +428,12 @@ def _add_query(sub):
     p.add_argument("--replica-log-dir", default=None, metavar="DIR",
                    help="capture one replica-N.log per process "
                         "(default: replicas inherit stderr)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="end-to-end distributed tracing root: the "
+                        "balancer records to balancer.jsonl, every "
+                        "replica to replica-N.jsonl, anomaly bundles "
+                        "land under flight/ — stitch with `cli "
+                        "trace-merge <DIR> --out trace.json`")
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--cache-size", type=int, default=65536)
     p.add_argument("--max-inflight", type=int, default=256)
@@ -656,6 +671,20 @@ def _add_query(sub):
                         "dispatch (default 1024)")
     p.add_argument("--metrics-out", default=None)
     _add_ann_flags(p)
+
+    p = sub.add_parser(
+        "trace-merge",
+        help="stitch per-process request-trace JSONL sinks (a "
+             "serve-fleet --trace-dir, or any set of --trace-log / "
+             "--event-log files) into one clock-anchored Chrome-trace "
+             "/ Perfetto JSON timeline",
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="trace JSONL files, or directories globbed "
+                        "for *.jsonl (+ rotated *.jsonl.1)")
+    p.add_argument("--out", required=True,
+                   help="merged Chrome-trace JSON output path (open "
+                        "in ui.perfetto.dev or chrome://tracing)")
 
     p = sub.add_parser(
         "eval", help="analogy accuracy on a standard question file"
@@ -1113,6 +1142,7 @@ def _run_serve_fleet(args) -> int:
         watch_poll=args.watch_poll,
         replica_flags=flags,
         log_dir=args.replica_log_dir,
+        trace_dir=args.trace_dir,
         port_file=args.port_file,
         max_restarts=args.max_restarts,
         backoff_base_seconds=args.backoff_base,
@@ -1131,6 +1161,43 @@ def _run_serve_fleet(args) -> int:
     )
 
 
+def _run_trace_merge(args) -> int:
+    """``trace-merge``: jax-free stitcher over EventRecorder JSONL
+    sinks — directories expand to their (rotated included) .jsonl
+    files, the merged document is written atomically, and the summary
+    line reports how many trace ids actually stitched across
+    processes."""
+    import glob
+    import os
+
+    from glint_word2vec_tpu.obs.aggregate import merge_trace_logs
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    paths = []
+    for inp in args.inputs:
+        if os.path.isdir(inp):
+            paths += sorted(
+                glob.glob(os.path.join(inp, "*.jsonl"))
+                + glob.glob(os.path.join(inp, "*.jsonl.1"))
+            )
+        else:
+            paths.append(inp)
+    if not paths:
+        print("error: no trace JSONL inputs found", file=sys.stderr)
+        return 1
+    doc = merge_trace_logs(paths)
+    atomic_write_json(args.out, doc)
+    other = doc["otherData"]
+    print(json.dumps({
+        "out": args.out,
+        "events": len(doc["traceEvents"]),
+        "trace_ids": other["trace_ids"],
+        "stitched_traces": other["stitched_traces"],
+        "sources": other["sources"],
+    }))
+    return 0
+
+
 def _run(args) -> int:
     if args.cmd == "supervise":
         # Before force_platform/jax: the supervisor process never
@@ -1140,6 +1207,9 @@ def _run(args) -> int:
         # Likewise device-free: the balancer proxies; only the replica
         # SUBPROCESSES load tables.
         return _run_serve_fleet(args)
+    if args.cmd == "trace-merge":
+        # Pure file stitching: no devices, no model loads.
+        return _run_trace_merge(args)
     if (args.cmd == "transform-file" and args.workers > 1
             and args.rank is None):
         # Rank-parallel bulk transform: the parent is a device-free
@@ -1250,6 +1320,8 @@ def _run(args) -> int:
             watch_dir=args.watch_checkpoint,
             watch_poll=args.watch_poll,
             port_file=args.port_file,
+            trace_log=args.trace_log,
+            flight_dir=args.flight_dir,
             **_ann_kwargs(args),
         )
         return 0

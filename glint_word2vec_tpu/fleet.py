@@ -67,6 +67,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from glint_word2vec_tpu.obs import events as obs_events
+from glint_word2vec_tpu.obs.slo import FlightRecorder
 from glint_word2vec_tpu.parallel.supervisor import (
     capped_backoff,
     terminate_process,
@@ -160,9 +162,24 @@ class ReplicaBreaker:
         self._closed_total = 0
         self._probe_failures = 0
         self._probe_successes = 0
+        #: Invoked on every CLOSED -> OPEN transition (a genuinely
+        #: healthy replica just got ejected), OUTSIDE ``_mu`` — the
+        #: flight recorder's breaker-trip snapshot hook scrapes every
+        #: replica and must never run under the breaker lock. Cooldown
+        #: refreshes and half-open re-opens do not re-fire.
+        self.on_open: Optional[Callable[[], None]] = None
+
+    def _fire_on_open(self) -> None:
+        cb = self.on_open
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("breaker on_open hook failed")
 
     def record_failure(self, probe: bool = False) -> None:
         """One failed probe or data-plane connection attempt."""
+        opened = False
         with self._mu:
             if probe:
                 self._probe_failures += 1
@@ -181,6 +198,9 @@ class ReplicaBreaker:
                     self._state = self.OPEN
                     self._opened_at = time.monotonic()
                     self._opened_total += 1
+                    opened = True
+        if opened:
+            self._fire_on_open()
 
     def record_success(self, probe: bool = False) -> None:
         """One healthy probe answer or successful proxied exchange."""
@@ -216,12 +236,16 @@ class ReplicaBreaker:
         """Supervisor seam: the replica process is KNOWN dead or
         restarting — eject immediately and keep refreshing the cooldown
         so no trial traffic flows until the supervisor readmits it."""
+        opened = False
         with self._mu:
             if self._state == self.CLOSED:
                 self._opened_total += 1
+                opened = True
             self._state = self.OPEN
             self._opened_at = time.monotonic()
             self._trial_successes = 0
+        if opened:
+            self._fire_on_open()
 
     def trial(self) -> None:
         """Supervisor seam: a relaunched replica adopted a fresh
@@ -335,11 +359,13 @@ class _ReplicaConn:
         return s
 
     def roundtrip(self, method: str, path: str, body: bytes,
-                  retryable: Optional[bool] = None):
+                  retryable: Optional[bool] = None,
+                  trace_id: Optional[str] = None):
         """One request/response exchange; returns (status, body,
         header-dict with lowercase keys). Raises on any transport
         error (caller drops the connection and tries the next
-        replica).
+        replica). ``trace_id`` propagates the balancer's request trace
+        to the replica (the ``X-Glint-Trace`` wire header — ISSUE 18).
 
         A stale keep-alive socket after a replica bounce fails in one
         of two places: the send (nothing reached a handler — always
@@ -351,8 +377,12 @@ class _ReplicaConn:
         surfaced transport error."""
         if retryable is None:
             retryable = method == "GET"
+        trace_hdr = (
+            f"{obs_events.TRACE_HEADER}: {trace_id}\r\n"
+            if trace_id else ""
+        )
         req = (
-            f"{method} {path} HTTP/1.1\r\n{self._prefix}"
+            f"{method} {path} HTTP/1.1\r\n{trace_hdr}{self._prefix}"
             f"{len(body)}\r\n\r\n"
         ).encode("latin-1") + body
         try:
@@ -436,7 +466,9 @@ class LoadBalancer:
     #: address — the retry/breaker machinery absorbs it. ``doc_extra``
     #: and ``on_shutdown`` are installed once by the fleet supervisor
     #: before the data plane starts.
-    _ATOMIC_ATTRS = frozenset({"replicas", "doc_extra", "on_shutdown"})
+    _ATOMIC_ATTRS = frozenset(
+        {"replicas", "doc_extra", "on_shutdown", "flight"}
+    )
 
     def __init__(self, replica_urls: List[str], host: str = "127.0.0.1",
                  port: int = 0, *, scrape_timeout: float = 2.0,
@@ -483,6 +515,10 @@ class LoadBalancer:
         #: are told to exit — the supervisor's don't-restart-the-dead
         #: flag must be up before the first replica goes down.
         self.on_shutdown: Optional[Callable[[], None]] = None
+        #: Armed by :meth:`enable_flight_recorder`: the fleet-wide
+        #: anomaly bundle writer, triggered by breaker CLOSED -> OPEN
+        #: transitions.
+        self.flight: Optional[FlightRecorder] = None
         self._local = threading.local()
         # Data plane: a thread-per-connection raw-socket loop with a
         # minimal HTTP/1.1 parser instead of ThreadingHTTPServer. The
@@ -612,7 +648,18 @@ class LoadBalancer:
             })
             threading.Thread(target=self.stop, daemon=True).start()
             return
-        status, rbody, rheaders = self.forward(method, path, body)
+        # Distributed tracing (ISSUE 18): adopt the client's trace id
+        # or mint one at the fleet edge; the balancer hop's root span
+        # wraps the whole proxy exchange, and the id rides the wire
+        # header so the replica's spans stitch to ours in trace-merge.
+        tr = obs_events.request_trace(
+            headers.get(obs_events.TRACE_HEADER.lower())
+        )
+        with tr.phase("req.accept", path=url.path, hop="balancer"):
+            status, rbody, rheaders = self.forward(
+                method, path, body, trace=tr
+            )
+        tr.finish(status)
         self._respond(
             sock, status, rbody,
             rheaders.get("content-type") or "application/json",
@@ -685,7 +732,8 @@ class LoadBalancer:
             self._rr += 1
             return self._rr
 
-    def _attempt(self, i: int, method: str, path: str, body: bytes):
+    def _attempt(self, i: int, method: str, path: str, body: bytes,
+                 trace_id: Optional[str] = None):
         """One replica attempt; (status, body, headers) or None on
         connection failure (breaker and error accounting applied). A
         connection-refused inside a known restart window retries the
@@ -694,7 +742,9 @@ class LoadBalancer:
         must not read as a dead-replica degrade."""
         for attempt in range(self.RESTART_RETRIES + 1):
             try:
-                return self._conn(i).roundtrip(method, path, body)
+                return self._conn(i).roundtrip(
+                    method, path, body, trace_id=trace_id
+                )
             except ConnectionRefusedError:
                 self._drop_conn(i)
                 if (not self.is_restarting(i)
@@ -714,14 +764,16 @@ class LoadBalancer:
         self.breakers[i].record_failure()
         return None
 
-    def forward(self, method: str, path: str, body: bytes):
+    def forward(self, method: str, path: str, body: bytes, trace=None):
         """Send one request to the fleet: round-robin start over
         CLOSED breakers, advance on connection failure or a shed
         status (429/503), at most one attempt per replica. Returns
         (status, body, headers). When every replica sheds, the LAST
         shed response is relayed — its Retry-After included — so the
         client sees the fleet's own backpressure, not an invented
-        error.
+        error. ``trace`` (a ``RequestTrace``) records one ``req.hop``
+        phase span per replica attempt and propagates its id to the
+        replica over the wire header.
 
         Open/half-open breakers are skipped (each skip is a timeout a
         client did not pay) and only attempted as a last resort when
@@ -729,6 +781,7 @@ class LoadBalancer:
         never attempted: a hold means a rollout drain or a canary
         serving a CANDIDATE generation that must not touch live
         traffic."""
+        tr = trace if trace is not None else obs_events.NULL_TRACE
         n = len(self.replicas)
         start = self._next_start()
         order = [(start + j) % n for j in range(n)]
@@ -744,7 +797,14 @@ class LoadBalancer:
         last_shed = None
         attempted = 0
         for i in eligible + fallback:
-            got = self._attempt(i, method, path, body)
+            with tr.phase("req.hop", replica=i) as hop:
+                got = self._attempt(
+                    i, method, path, body,
+                    trace_id=tr.trace_id or None,
+                )
+                hop.update(
+                    outcome="conn_error" if got is None else int(got[0])
+                )
             attempted += 1
             if got is None:
                 continue
@@ -998,6 +1058,78 @@ class LoadBalancer:
                     "url": self.replica_url(i), "error": str(e),
                 })
         return results
+
+    # -- anomaly flight recorder (ISSUE 18) ----------------------------
+
+    def enable_flight_recorder(
+        self, out_dir: str, *, window_seconds: float = 30.0,
+        min_interval_seconds: float = 60.0,
+    ) -> FlightRecorder:
+        """Arm the fleet-wide anomaly flight recorder: a breaker's
+        CLOSED -> OPEN transition (a healthy replica just got ejected)
+        snapshots the last ``window_seconds`` of spans and metrics from
+        the balancer AND every reachable replica into a postmortem
+        bundle under ``out_dir``. Bundles are rate-limited; the
+        recorder never raises into the data plane."""
+        fl = FlightRecorder(
+            out_dir, window_seconds=window_seconds,
+            min_interval_seconds=min_interval_seconds,
+        )
+        fl.add_source("balancer", self._flight_balancer)
+        fl.add_source("replica_spans", self._flight_replica_spans)
+        fl.add_source("replica_metrics", self._flight_replica_metrics)
+        self.flight = fl
+        for i, b in enumerate(self.breakers):
+            b.on_open = (
+                lambda i=i: fl.trigger("breaker_open", replica=i)
+            )
+        return fl
+
+    def _flight_balancer(self, window_seconds: float) -> dict:
+        doc: Dict[str, object] = {
+            "balancer": self.balancer_stats(),
+            "breakers": [b.snapshot() for b in self.breakers],
+        }
+        rec = obs_events.get_recorder()
+        if rec is not None:
+            doc["spans"] = rec.recent_events(window_seconds)
+            doc["anchor"] = {
+                "wall_t0": rec.wall_t0, "mono_t0": rec.mono_t0,
+            }
+        return doc
+
+    def _flight_replica_spans(self, window_seconds: float) -> dict:
+        """Every replica's recent span window (its /trace route): the
+        bundle shows what the whole fleet was doing when the anomaly
+        fired, not just the process that noticed it."""
+        out = {}
+        for i in range(len(self.replicas)):
+            try:
+                _, doc = self._get_json(
+                    i, f"/trace?seconds={window_seconds:g}"
+                )
+                out[f"replica_{i}"] = {
+                    "url": self.replica_url(i), "trace": doc,
+                }
+            except Exception as e:
+                out[f"replica_{i}"] = {
+                    "url": self.replica_url(i), "error": str(e),
+                }
+        return out
+
+    def _flight_replica_metrics(self, window_seconds: float) -> dict:
+        out = {}
+        for i in range(len(self.replicas)):
+            try:
+                _, snap = self._get_json(i, "/metrics")
+                out[f"replica_{i}"] = {
+                    "url": self.replica_url(i), "snapshot": snap,
+                }
+            except Exception as e:
+                out[f"replica_{i}"] = {
+                    "url": self.replica_url(i), "error": str(e),
+                }
+        return out
 
     # -- lifecycle -----------------------------------------------------
 
@@ -1744,6 +1876,7 @@ class FleetSupervisor:
         watch_poll: float = 1.0,
         replica_flags: Optional[List[str]] = None,
         log_dir: Optional[str] = None,
+        trace_dir: Optional[str] = None,
         ready_timeout: float = 900.0,
         port_file: Optional[str] = None,
         max_restarts: int = 3,
@@ -1773,6 +1906,13 @@ class FleetSupervisor:
         self.watch_poll = float(watch_poll)
         self.replica_flags = list(replica_flags or [])
         self.log_dir = log_dir
+        #: Distributed-tracing root (ISSUE 18): when set, the balancer
+        #: records its spans to ``<trace_dir>/balancer.jsonl``, every
+        #: replica gets ``--trace-log``/``--flight-dir`` flags pointing
+        #: into it, and the balancer's fleet-wide flight recorder
+        #: bundles into ``<trace_dir>/flight``. ``cli trace-merge``
+        #: stitches the per-process JSONLs into one Perfetto timeline.
+        self.trace_dir = trace_dir
         self.ready_timeout = float(ready_timeout)
         self.port_file = port_file
         self.max_restarts = int(max_restarts)
@@ -1831,6 +1971,13 @@ class FleetSupervisor:
             argv += [
                 "--watch-checkpoint", self.watch_dir,
                 "--watch-poll", str(self.watch_poll),
+            ]
+        if self.trace_dir:
+            argv += [
+                "--trace-log",
+                os.path.join(self.trace_dir, f"replica-{index}.jsonl"),
+                "--flight-dir",
+                os.path.join(self.trace_dir, "flight"),
             ]
         return argv + list(self.replica_flags)
 
@@ -2129,6 +2276,15 @@ class FleetSupervisor:
                 boot_gen = self._resolve_boot()
                 if self._stop.is_set():
                     return 0
+                if self.trace_dir:
+                    # Before the first replica launch: the replicas'
+                    # --trace-log sinks open inside this directory.
+                    os.makedirs(self.trace_dir, exist_ok=True)
+                    obs_events.set_recorder(obs_events.EventRecorder(
+                        jsonl_path=os.path.join(
+                            self.trace_dir, "balancer.jsonl"
+                        ),
+                    ))
                 for slot in self._slots:
                     self._launch(slot)
                 self._wait_initial_ready()
@@ -2152,6 +2308,10 @@ class FleetSupervisor:
                     )
                 self.lb.doc_extra = self._doc_extra
                 self.lb.on_shutdown = self._stop.set
+                if self.trace_dir:
+                    self.lb.enable_flight_recorder(
+                        os.path.join(self.trace_dir, "flight")
+                    )
                 if self.port_file:
                     from glint_word2vec_tpu.utils import atomic_write_json
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import statistics
 import threading
 import urllib.request
@@ -40,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from glint_word2vec_tpu.obs.slo import merge_slo_snapshots
 from glint_word2vec_tpu.utils.metrics import LEDGER_PHASES, LatencyHistogram
 
 logger = logging.getLogger(__name__)
@@ -110,6 +112,8 @@ def merge_training_snapshots(
     shard_write_max = None
     shard_verify_max = None
     transform_ranks: List[dict] = []
+    slo_snaps: List[dict] = []
+    steptime_trace_id = None
     per_rank: Dict[str, dict] = {}
     wps_total = 0.0
     step_means: List[float] = []
@@ -137,6 +141,15 @@ def merge_training_snapshots(
         tr = snap.get("transform")
         if tr:
             transform_ranks.append(tr)
+        if snap.get("slo"):
+            slo_snaps.append(snap["slo"])
+        if steptime_trace_id is None:
+            # First rank carrying a gang trace id wins: the supervisor
+            # mints ONE id per launch generation, so any rank's is the
+            # gang's (the steptime summary's exemplar anchor).
+            steptime_trace_id = (
+                (snap.get("steptime") or {}).get("trace_id")
+            )
         wps = float(snap.get("words_per_sec_rolling") or 0.0)
         wps_total += wps
         ms = _mean_step_seconds(snap)
@@ -263,9 +276,13 @@ def merge_training_snapshots(
         "checkpoint_shard_verify_seconds_max": shard_verify_max,
         "per_rank": per_rank,
         "steptime": steptime,
+        "steptime_trace_id": steptime_trace_id,
     }
     if transform is not None:
         out["transform"] = transform
+    slo = merge_slo_snapshots(slo_snaps)
+    if slo is not None:
+        out["slo"] = slo
     return out
 
 
@@ -461,6 +478,103 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         "hot_swap": swap,
         "checkpoint": ck,
         "index": index,
+        # Fleet SLO view (ISSUE 18): window counts sum exactly, burn
+        # rates re-derived from the sums — a replica restart never
+        # corrupts the merged error budget.
+        "slo": merge_slo_snapshots([s.get("slo") for s in snaps]),
+    }
+
+
+def merge_trace_logs(paths: Iterable[str]) -> dict:
+    """Stitch per-process ``EventRecorder`` JSONL rings into ONE
+    clock-anchored Chrome-trace / Perfetto document (ISSUE 18).
+
+    Each sink's events carry ``ts`` microseconds on that process's OWN
+    monotonic clock; its leading ``clock_anchor`` metadata line records
+    the ``(mono_t0, wall_t0)`` pair mapping ts=0 back to the epoch.
+    The merge rebases every file onto the earliest ``wall_t0`` across
+    inputs, so spans from the balancer, every replica, and a training
+    gang land on one shared timeline — a stitched request reads
+    balancer ``req.accept`` -> replica ``req.accept`` ->
+    ``req.dispatch`` left to right in Perfetto.
+
+    Per-process lanes are named after the source file
+    (``process_name`` metadata); a missing file or a torn trailing
+    line (a crash mid-write) is skipped and reported in
+    ``otherData.sources``, never fatal. ``otherData.stitched_traces``
+    counts trace ids seen in more than one process — the CI smoke
+    asserts it is nonzero for a traced fleet.
+    """
+    events: List[dict] = []
+    sources: Dict[str, str] = {}
+    anchors: Dict[str, dict] = {}
+    per_file: List[tuple] = []
+    base_wall = None
+    for path in paths:
+        name = os.path.basename(path)
+        if name.endswith(".jsonl"):
+            name = name[: -len(".jsonl")]
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError as e:
+            sources[str(path)] = f"error: {e}"
+            continue
+        anchor = None
+        evs = []
+        bad = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                bad += 1  # torn trailing line from a crashed writer
+                continue
+            if ev.get("name") == "clock_anchor" and ev.get("ph") == "M":
+                if anchor is None:
+                    anchor = ev.get("args") or {}
+                continue
+            evs.append(ev)
+        if anchor is None or anchor.get("wall_t0") is None:
+            sources[str(path)] = "error: no clock_anchor line"
+            continue
+        sources[str(path)] = (
+            "ok" if not bad else f"ok ({bad} torn line(s) skipped)"
+        )
+        anchors[name] = anchor
+        wall = float(anchor["wall_t0"])
+        base_wall = wall if base_wall is None else min(base_wall, wall)
+        per_file.append((name, wall, evs))
+    trace_pids: Dict[str, set] = {}
+    for name, wall, evs in per_file:
+        offset_us = (wall - base_wall) * 1e6
+        pid = evs[0].get("pid") if evs else None
+        for ev in evs:
+            ev = dict(ev)
+            ev["ts"] = round(float(ev.get("ts") or 0.0) + offset_us, 1)
+            events.append(ev)
+            tid = (ev.get("args") or {}).get("trace")
+            if tid:
+                trace_pids.setdefault(tid, set()).add(name)
+        if pid is not None:
+            events.append({
+                "name": "process_name", "ph": "M", "ts": 0,
+                "pid": pid, "args": {"name": name},
+            })
+    events.sort(key=lambda e: (e.get("ts") or 0.0))
+    stitched = sum(1 for pids in trace_pids.values() if len(pids) > 1)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_t0": base_wall,
+            "sources": sources,
+            "anchors": anchors,
+            "trace_ids": len(trace_pids),
+            "stitched_traces": stitched,
+        },
     }
 
 
@@ -576,6 +690,14 @@ class GangStatusServer:
             serving, sources = self._scrape_serving()
             merged["serving"] = serving
             merged["serving_sources"] = sources
+            # Lift the scraped replicas' merged SLO view next to any
+            # training-rank objectives so the gang exposition renders
+            # one glint_gang_slo_* family set for the whole deployment.
+            slo = merge_slo_snapshots([
+                merged.get("slo"), (serving or {}).get("slo"),
+            ])
+            if slo is not None:
+                merged["slo"] = slo
         return merged
 
     def _scrape_serving(self):

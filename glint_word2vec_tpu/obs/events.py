@@ -9,7 +9,7 @@ re-exports as a Chrome-trace (``chrome://tracing`` / Perfetto) JSON so
 host spans can be eyeballed against the device xplane traces
 ``scripts/trace_summarize.py`` parses.
 
-Two usage layers:
+Three usage layers:
 
 - ``EventRecorder`` — the recorder object a run owns (obs.ObsRun wires
   one per instrumented fit).
@@ -18,10 +18,21 @@ Two usage layers:
   recorder installed they cost one global read (and ``span`` returns a
   shared no-op context manager), so the disabled path stays off the fit
   hot loop's profile.
+- ``RequestTrace`` (ISSUE 18) — the per-request phase-span buffer the
+  serving data plane uses for distributed tracing: the balancer mints a
+  trace id (:func:`mint_trace_id`), propagates it over the wire
+  (``X-Glint-Trace``), and every hop buffers its request-path phase
+  spans locally, flushing them into the ring only when the tail-based
+  sampler keeps the request (always: errors, sheds, slow requests;
+  1-in-N otherwise). Request-path span NAMES are drawn from the
+  :data:`REQUEST_SPANS` registry — graftlint's span-registry rule
+  rejects ad-hoc string literals at instrumentation sites.
 """
 
 from __future__ import annotations
 
+import atexit
+import itertools
 import json
 import logging
 import os
@@ -31,6 +42,51 @@ from collections import deque
 from typing import Optional
 
 logger = logging.getLogger(__name__)
+
+#: The request-path span vocabulary (ISSUE 18). Every distributed-
+#: tracing instrumentation site (``RequestTrace.phase`` /
+#: ``RequestTrace.add_phase`` / module-level ``phase_span``) MUST name
+#: its span with a literal key of this dict — graftlint's span-registry
+#: checker statically enforces both directions (no ad-hoc names at
+#: sites, no dead registry entries), so the trace-merge tooling and the
+#: CI stitch assertions can rely on the vocabulary.
+REQUEST_SPANS = {
+    "req.accept": "per-process root span of one request hop "
+                  "(balancer or replica handler, accept to response)",
+    "req.admission": "admission gate: inflight-slot acquire or shed",
+    "req.queue": "coalescer queue wait, enqueue to leader drain",
+    "req.hop": "balancer -> replica proxy attempt (one per retry hop)",
+    "req.dispatch": "warm-bucket device dispatch of one coalesced batch",
+    "req.query": "engine query path (args carry mode=ann|exact)",
+    "req.readback": "device result harvest / host materialization",
+    "req.serialize": "response serialization + socket write",
+}
+
+#: Wire header carrying the trace id across the balancer -> replica hop.
+TRACE_HEADER = "X-Glint-Trace"
+
+#: Tail-sampling knobs: keep 1 in GLINT_TRACE_SAMPLE of the healthy/fast
+#: requests; always keep errors (status >= 400, which covers sheds and
+#: deadline 504s) and requests slower than GLINT_TRACE_SLOW_MS.
+_TRACE_SAMPLE_EVERY = max(
+    1, int(os.environ.get("GLINT_TRACE_SAMPLE") or 32)
+)
+_TRACE_SLOW_MS = float(os.environ.get("GLINT_TRACE_SLOW_MS") or 250.0)
+
+#: Default JSONL sink rotation bound (satellite: a long traced run must
+#: not grow the sink without limit). One rotated generation is kept
+#: (``<path>.1``), so worst-case disk is ~2x this.
+_SINK_MAX_BYTES = int(
+    os.environ.get("GLINT_EVENT_SINK_MAX_BYTES") or 64 * 1024 * 1024
+)
+
+_sample_counter = itertools.count()
+
+
+def mint_trace_id() -> str:
+    """A 16-hex-char request trace id (no RNG seeding interplay: reads
+    the OS entropy pool directly)."""
+    return os.urandom(8).hex()
 
 
 class _NullSpan:
@@ -76,47 +132,225 @@ class _Span:
         self._args.update(args)
 
 
+class _Phase:
+    """Context manager buffering one request phase span into its
+    :class:`RequestTrace` (not the ring — the tail sampler decides at
+    ``finish`` whether the buffered spans are flushed at all)."""
+
+    __slots__ = ("_tr", "_name", "_args", "_t0")
+
+    def __init__(self, tr: "RequestTrace", name: str, args: dict):
+        self._tr = tr
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tr._spans.append(
+            (self._name, self._t0, t1 - self._t0, self._args)
+        )
+        return False
+
+    def update(self, **args) -> None:
+        self._args.update(args)
+
+
+class NullRequestTrace:
+    """Trace-id-carrying no-op returned when no recorder is installed:
+    the id still propagates over the wire (a downstream hop may be
+    recording even when this one is not), but nothing is buffered."""
+
+    __slots__ = ("trace_id", "kept")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.kept = False
+
+    def phase(self, name: str, **args):
+        return NULL_SPAN
+
+    def add_phase(self, name: str, t0: float, dur: float, **args) -> None:
+        pass
+
+    def finish(self, status: int = 200, *, force: bool = False) -> bool:
+        return False
+
+
+#: Shared stateless no-op trace for call sites whose caller passed no
+#: trace at all (direct library use of the coalescer, tests).
+NULL_TRACE = NullRequestTrace("")
+
+
+class RequestTrace:
+    """Per-request phase-span buffer with tail-based sampling.
+
+    One hop's handler owns one instance (single-writer; cross-thread
+    phases — the coalescer leader's queue/dispatch timestamps — are
+    converted by the OWNING handler thread via :meth:`add_phase`).
+    ``finish(status)`` applies the tail-sampling policy: errors/sheds
+    (status >= 400), slow requests, and ``force`` are always kept;
+    everything else is kept 1 in ``GLINT_TRACE_SAMPLE``. Kept spans are
+    flushed into the recorder ring/sink with the trace id attached, so
+    ``cli trace-merge`` can stitch hops across processes by id.
+    """
+
+    __slots__ = ("trace_id", "_rec", "_spans", "_t0", "kept")
+
+    def __init__(self, trace_id: str, rec: "EventRecorder"):
+        self.trace_id = trace_id
+        self._rec = rec
+        self._spans: list = []
+        self._t0 = time.perf_counter()
+        self.kept = False
+
+    def phase(self, name: str, **args) -> _Phase:
+        """Context manager buffering one registry-named phase span."""
+        return _Phase(self, name, args)
+
+    def add_phase(self, name: str, t0: float, dur: float, **args) -> None:
+        """Buffer a phase from externally captured timestamps (the
+        coalescer leader stamps perf_counter() pairs into the request
+        dict; the waiter thread converts them here)."""
+        self._spans.append((name, t0, dur, args))
+
+    def finish(self, status: int = 200, *, force: bool = False) -> bool:
+        """Apply tail sampling; flush buffered spans if kept. Returns
+        whether the trace was kept (the caller can use that to attach
+        an exemplar only for traces that actually exist in the ring)."""
+        spans, self._spans = self._spans, []
+        if not spans:
+            return False
+        slow = (time.perf_counter() - self._t0) * 1e3 >= _TRACE_SLOW_MS
+        keep = (
+            force or slow or int(status) >= 400
+            or next(_sample_counter) % _TRACE_SAMPLE_EVERY == 0
+        )
+        if not keep:
+            return False
+        # The root span (req.accept closes last, so it is the final
+        # buffered entry) carries the response status.
+        spans[-1][3].setdefault("status", int(status))
+        for name, t0, dur, args in spans:
+            a = dict(args)
+            a["trace"] = self.trace_id
+            self._rec._record(name, "X", t0, dur, a)
+        self.kept = True
+        return True
+
+
+def request_trace(trace_id: Optional[str] = None, rec=None):
+    """Start one hop's request trace: adopts the propagated trace id (or
+    mints one at the edge) and binds the process recorder. With no
+    recorder installed this degrades to a :class:`NullRequestTrace` that
+    still carries the id for onward propagation."""
+    if rec is None:
+        rec = _current
+    tid = trace_id or mint_trace_id()
+    if rec is None:
+        return NullRequestTrace(tid)
+    return RequestTrace(tid, rec)
+
+
 class EventRecorder:
     """Thread-safe span/event log: the newest ``capacity`` events in a
     bounded ring (overflow counted in ``dropped``, never unbounded host
     memory) plus an optional JSONL sink that receives EVERY event.
 
     Event timestamps (``ts``, microseconds) run on a process-local
-    monotonic clock anchored at recorder construction; ``wall_t0`` maps
-    them back to the epoch for correlation with device traces. Span
-    events use the Chrome-trace complete form (``ph: "X"`` with ``dur``),
-    instants ``ph: "i"`` — each JSONL line IS a valid traceEvents entry,
-    and :meth:`chrome_trace` wraps the ring into a full document.
+    monotonic clock anchored at recorder construction; the
+    ``(mono_t0, wall_t0)`` pair recorded at creation (and emitted as the
+    sink's ``clock_anchor`` metadata line) maps them back to the epoch,
+    so multi-process merges align per-process timelines exactly. Span
+    events use the Chrome-trace complete form (``ph: "X"`` with
+    ``dur``), instants ``ph: "i"`` — each JSONL line IS a valid
+    traceEvents entry, and :meth:`chrome_trace` wraps the ring into a
+    full document.
+
+    The sink is bounded: once it exceeds ``max_sink_bytes`` it rotates
+    to ``<path>.1`` (one generation kept) and restarts with a fresh
+    clock-anchor line, and an ``atexit`` flush makes sure an
+    un-``close()``-d recorder still leaves complete lines behind.
     """
 
     def __init__(self, capacity: int = 65536,
-                 jsonl_path: Optional[str] = None):
+                 jsonl_path: Optional[str] = None,
+                 max_sink_bytes: Optional[int] = None):
         self._mu = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self.recorded = 0
         self.dropped = 0
         self.jsonl_path = jsonl_path
+        self.max_sink_bytes = int(
+            max_sink_bytes if max_sink_bytes is not None
+            else _SINK_MAX_BYTES
+        )
+        self.sink_rotations = 0
+        self._sink_bytes = 0
         self.wall_t0 = time.time()
         self._t0 = time.perf_counter()
         # graftlint: ignore[atomic-persist] streaming JSONL sink, not an artifact: a crash leaves a valid line-prefix that the merge/summarize tools accept
         self._sink = open(jsonl_path, "w") if jsonl_path else None
         if self._sink is not None:
-            # Clock-anchor metadata line (Chrome-trace "M" event, ignored
-            # by viewers): maps this recorder's monotonic ts=0 back to
-            # the epoch, so scripts/trace_summarize.py --merge-ranks can
-            # align per-rank JSONLs onto one shared timeline.
-            try:
-                self._sink.write(json.dumps({
-                    "name": "clock_anchor", "ph": "M", "ts": 0,
-                    "pid": os.getpid(),
-                    "args": {"wall_t0": self.wall_t0},
-                }) + "\n")
-            except OSError as e:
-                self._drop_sink_locked(e)
+            self._write_anchor_locked()
+            # Crash/exit hygiene: a run that never reaches close() (a
+            # SIGTERMed replica, a test that leaks the recorder) still
+            # flushes buffered lines. close() unregisters.
+            atexit.register(self.flush)
 
     @property
     def capacity(self) -> int:
         return self._ring.maxlen
+
+    @property
+    def mono_t0(self) -> float:
+        """Monotonic half of the clock-anchor pair (``ts`` zero)."""
+        return self._t0
+
+    def _anchor_args(self) -> dict:
+        args = {"wall_t0": self.wall_t0, "mono_t0": self._t0}
+        # A supervisor-minted gang trace id (one per launch generation)
+        # stitches this process's whole timeline to its gang.
+        gang = os.environ.get("GLINT_TRACE_ID")
+        if gang:
+            args["trace"] = gang
+        return args
+
+    def _write_anchor_locked(self) -> None:
+        """Clock-anchor metadata line (Chrome-trace "M" event, ignored
+        by viewers): the (monotonic, wall) epoch pair mapping this
+        recorder's ts=0 back to the epoch, so trace-merge tools can
+        align per-process JSONLs onto one shared timeline."""
+        try:
+            line = json.dumps({
+                "name": "clock_anchor", "ph": "M", "ts": 0,
+                "pid": os.getpid(),
+                "args": self._anchor_args(),
+            }) + "\n"
+            self._sink.write(line)
+            self._sink_bytes += len(line)
+        except OSError as e:
+            self._drop_sink_locked(e)
+
+    def _rotate_sink_locked(self) -> None:
+        """Size-bounded sink: rotate the full file to ``<path>.1`` and
+        restart (fresh anchor). One kept generation bounds disk at
+        ~2x ``max_sink_bytes`` however long the traced run lives."""
+        try:
+            self._sink.flush()
+            self._sink.close()
+            os.replace(self.jsonl_path, self.jsonl_path + ".1")
+            # graftlint: ignore[atomic-persist] streaming JSONL sink (see __init__)
+            self._sink = open(self.jsonl_path, "w")
+        except OSError as e:
+            self._drop_sink_locked(e)
+            return
+        self._sink_bytes = 0
+        self.sink_rotations += 1
+        self._write_anchor_locked()
 
     def _record(self, name: str, ph: str, t0: float, dur: float,
                 args: dict) -> None:
@@ -140,12 +374,17 @@ class EventRecorder:
             self._ring.append(ev)
             if self._sink is not None:
                 try:
-                    self._sink.write(json.dumps(ev) + "\n")
+                    line = json.dumps(ev) + "\n"
+                    self._sink.write(line)
+                    self._sink_bytes += len(line)
                 except OSError as e:
                     # Observability must never take down the run it
                     # monitors: a dying sink (disk full, quota) degrades
                     # to ring-only recording.
                     self._drop_sink_locked(e)
+                    return
+                if self._sink_bytes >= self.max_sink_bytes:
+                    self._rotate_sink_locked()
 
     def event(self, name: str, **args) -> None:
         """Record one instant event."""
@@ -159,6 +398,13 @@ class EventRecorder:
         """Snapshot of the ring (oldest first)."""
         with self._mu:
             return list(self._ring)
+
+    def recent_events(self, seconds: float) -> list:
+        """Ring events whose span/instant started within the last
+        ``seconds`` — the flight recorder's bundle window."""
+        cutoff = (time.perf_counter() - self._t0 - seconds) * 1e6
+        with self._mu:
+            return [e for e in self._ring if e.get("ts", 0.0) >= cutoff]
 
     def counts(self) -> dict:
         with self._mu:
@@ -180,6 +426,7 @@ class EventRecorder:
             "displayTimeUnit": "ms",
             "otherData": {
                 "wall_t0": self.wall_t0,
+                "mono_t0": self._t0,
                 "dropped": dropped,
             },
         }
@@ -222,6 +469,10 @@ class EventRecorder:
                         self.jsonl_path, e,
                     )
                 self._sink = None
+                try:
+                    atexit.unregister(self.flush)
+                except Exception:  # pragma: no cover - interpreter teardown
+                    pass
 
 
 # ----------------------------------------------------------------------
@@ -257,6 +508,18 @@ def span(name: str, **args):
     """Span on the current recorder; the shared no-op context manager
     when recording is off (the disabled path must cost ~nothing on the
     fit hot loop)."""
+    rec = _current
+    if rec is None:
+        return NULL_SPAN
+    return rec.span(name, **args)
+
+
+def phase_span(name: str, **args):
+    """Request-path span recorded DIRECTLY into the ring (never
+    tail-sampled away): the coalescer leader's device-dispatch lane uses
+    this so the stitched trace always shows the batch a kept request
+    rode in. ``name`` must be a :data:`REQUEST_SPANS` literal —
+    graftlint's span-registry rule checks call sites statically."""
     rec = _current
     if rec is None:
         return NULL_SPAN

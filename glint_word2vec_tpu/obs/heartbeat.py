@@ -87,12 +87,15 @@ class TrainingStatus:
 
     def __init__(self, *, pipeline: str = "", total_epochs: int = 0,
                  total_words: int = 0, metrics=None, engine=None,
-                 recorder=None, ledger=None):
+                 recorder=None, ledger=None, slo=None):
         self._mu = threading.Lock()
         #: Optional utils.metrics.StepTimeLedger — the step-time
         #: attribution breakdown surfaced under ``steptime`` in every
         #: snapshot (and merged across ranks by obs.aggregate).
         self._ledger = ledger
+        #: Optional obs.slo.SloEngine — burn-rate objectives surfaced
+        #: under ``slo`` (ISSUE 18); None keeps snapshots unchanged.
+        self._slo = slo
         self.pipeline = pipeline
         self.total_epochs = int(total_epochs)
         self.total_words = int(total_words)
@@ -130,7 +133,7 @@ class TrainingStatus:
         self._rolling: deque = deque(maxlen=self.ROLLING)
 
     def attach(self, *, metrics=None, engine=None, recorder=None,
-               ledger=None) -> None:
+               ledger=None, slo=None) -> None:
         with self._mu:
             if metrics is not None:
                 self._metrics = metrics
@@ -140,6 +143,8 @@ class TrainingStatus:
                 self._recorder = recorder
             if ledger is not None:
                 self._ledger = ledger
+            if slo is not None:
+                self._slo = slo
 
     def update(self, *, epoch=None, step=None, words_done=None, alpha=None,
                state=None) -> None:
@@ -238,7 +243,7 @@ class TrainingStatus:
     def snapshot(self, include_devices: bool = True) -> dict:
         with self._mu:
             m, eng, rec = self._metrics, self._engine, self._recorder
-            ledger = self._ledger
+            ledger, slo = self._ledger, self._slo
             snap = {
                 "state": self.state,
                 "pipeline": self.pipeline,
@@ -358,6 +363,10 @@ class TrainingStatus:
             # Step-time attribution (ISSUE 8): per-phase wall seconds
             # with the histogram state the gang aggregator merges.
             snap["steptime"] = ledger.snapshot()
+        if slo is not None:
+            # SLO burn rates (ISSUE 18): training-side objectives (e.g.
+            # a step-latency SLI) rendered as glint_training_slo_*.
+            snap["slo"] = slo.snapshot()
         if include_devices:
             snap["device_memory"] = device_memory_stats()
         return snap

@@ -47,12 +47,21 @@ class _Prom:
         self.lines.append(f"# HELP {name} {help_}")
         self.lines.append(f"# TYPE {name} {mtype}")
 
-    def sample(self, name: str, labels, value) -> None:
+    def sample(self, name: str, labels, value, exemplar=None) -> None:
         if labels:
             lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
-            self.lines.append(f"{name}{{{lab}}} {_num(value)}")
+            line = f"{name}{{{lab}}} {_num(value)}"
         else:
-            self.lines.append(f"{name} {_num(value)}")
+            line = f"{name} {_num(value)}"
+        if exemplar and exemplar.get("trace_id"):
+            # OpenMetrics-style exemplar suffix: a trace id pinned to
+            # one recent observation, so a dashboard quantile links
+            # straight to the trace that produced it.
+            line += (
+                f' # {{trace_id="{_esc(exemplar["trace_id"])}"}}'
+                f' {_num(exemplar.get("value"))}'
+            )
+        self.lines.append(line)
 
     def text(self) -> str:
         return "\n".join(self.lines) + "\n"
@@ -217,9 +226,16 @@ def training_to_prometheus(snap: dict) -> str:
                      {"phase": phase}, info.get("seconds"))
         p.head("glint_training_steptime_ops_total", "counter",
                "Accounted spans per ledger phase.")
+        # Exemplar: the gang trace id the supervisor minted for this
+        # generation (GLINT_TRACE_ID), so a steptime sample links back
+        # to the merged gang trace it belongs to.
+        trace_id = (snap.get("steptime") or {}).get("trace_id")
         for phase, info in steptime.items():
             p.sample("glint_training_steptime_ops_total",
-                     {"phase": phase}, info.get("count", 0))
+                     {"phase": phase}, info.get("count", 0),
+                     exemplar=({"trace_id": trace_id,
+                                "value": info.get("seconds")}
+                               if trace_id else None))
     stream = snap.get("streaming") or {}
     if stream:
         # Streaming-trainer gauges (ISSUE 10): present only on
@@ -307,6 +323,74 @@ def training_to_prometheus(snap: dict) -> str:
         ]:
             p.head(name, "gauge", help_)
             p.sample(name, None, transform.get(key))
+    slo = snap.get("slo") or {}
+    if slo:
+        # SLO burn-rate families (ISSUE 18): objectives + rolling-window
+        # counts + derived burn rates from obs/slo.SloEngine, prefixed
+        # per renderer so concatenated scrapes stay family-disjoint.
+        slo_eps = slo.get("endpoints") or {}
+        p.head("glint_training_slo_availability_target", "gauge",
+               "Availability objective (success-ratio target) per "
+               "tracked endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_training_slo_availability_target",
+                     {"endpoint": ep}, doc.get("availability_target"))
+        p.head("glint_training_slo_latency_target", "gauge",
+               "Latency objective (fraction of good requests under the "
+               "threshold) per tracked endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_training_slo_latency_target",
+                     {"endpoint": ep}, doc.get("latency_target"))
+        p.head("glint_training_slo_latency_threshold_ms", "gauge",
+               "Latency SLI threshold in milliseconds per endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_training_slo_latency_threshold_ms",
+                     {"endpoint": ep}, doc.get("latency_threshold_ms"))
+        p.head("glint_training_slo_window_requests", "gauge",
+               "Requests observed in each rolling SLO window.")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_training_slo_window_requests",
+                         {"endpoint": ep, "window": win},
+                         w.get("total", 0))
+        p.head("glint_training_slo_window_bad", "gauge",
+               "SLI-violating requests in each rolling window, by SLI "
+               "(availability = 5xx; latency = slower than threshold).")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_training_slo_window_bad",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, w.get("bad_availability", 0))
+                p.sample("glint_training_slo_window_bad",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, w.get("bad_latency", 0))
+        p.head("glint_training_slo_burn_rate", "gauge",
+               "Error-budget burn rate per SLI and window (1.0 = "
+               "burning exactly the budget).")
+        for ep, doc in slo_eps.items():
+            burns = doc.get("burn_rates") or {}
+            for win, rate in (burns.get("availability") or {}).items():
+                p.sample("glint_training_slo_burn_rate",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, rate)
+            for win, rate in (burns.get("latency") or {}).items():
+                p.sample("glint_training_slo_burn_rate",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, rate)
+        p.head("glint_training_slo_fast_burn", "gauge",
+               "Multi-window fast-burn alert (5m AND 1h over 14.4x): "
+               "page-severity budget burn.")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_training_slo_fast_burn", {"endpoint": ep},
+                     1 if alerts.get("fast_burn") else 0)
+        p.head("glint_training_slo_slow_burn", "gauge",
+               "Multi-window slow-burn alert (30m AND 6h over 6x): "
+               "ticket-severity budget burn.")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_training_slo_slow_burn", {"endpoint": ep},
+                     1 if alerts.get("slow_burn") else 0)
     mem = snap.get("device_memory") or {}
     if mem:
         p.head("glint_device_memory_bytes", "gauge",
@@ -479,8 +563,86 @@ def gang_to_prometheus(snap: dict) -> str:
             p.sample("glint_gang_steptime_span_seconds_sum",
                      {"phase": phase},
                      info.get("span_seconds", info.get("seconds")))
+            # Exemplar: the supervisor-minted gang trace id (first rank
+            # reporting one), linking the merged summary to the merged
+            # gang trace.
+            trace_id = snap.get("steptime_trace_id")
             p.sample("glint_gang_steptime_span_seconds_count",
-                     {"phase": phase}, info.get("count", 0))
+                     {"phase": phase}, info.get("count", 0),
+                     exemplar=({"trace_id": trace_id,
+                                "value": info.get("span_seconds")}
+                               if trace_id else None))
+    slo = snap.get("slo") or {}
+    if slo:
+        # SLO burn-rate families (ISSUE 18) over the fleet-merged SLO
+        # snapshot the gang aggregator lifted from its serving scrape
+        # (gang-prefixed: this exposition is concatenated with the
+        # serving one, and families in one scrape must be disjoint).
+        slo_eps = slo.get("endpoints") or {}
+        p.head("glint_gang_slo_availability_target", "gauge",
+               "Availability objective (success-ratio target) per "
+               "tracked endpoint, fleet-merged view.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_gang_slo_availability_target",
+                     {"endpoint": ep}, doc.get("availability_target"))
+        p.head("glint_gang_slo_latency_target", "gauge",
+               "Latency objective (fraction of good requests under the "
+               "threshold) per tracked endpoint, fleet-merged view.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_gang_slo_latency_target",
+                     {"endpoint": ep}, doc.get("latency_target"))
+        p.head("glint_gang_slo_latency_threshold_ms", "gauge",
+               "Latency SLI threshold in milliseconds per endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_gang_slo_latency_threshold_ms",
+                     {"endpoint": ep}, doc.get("latency_threshold_ms"))
+        p.head("glint_gang_slo_window_requests", "gauge",
+               "Requests observed in each rolling SLO window, summed "
+               "over replicas.")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_gang_slo_window_requests",
+                         {"endpoint": ep, "window": win},
+                         w.get("total", 0))
+        p.head("glint_gang_slo_window_bad", "gauge",
+               "SLI-violating requests in each rolling window, by SLI, "
+               "summed over replicas.")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_gang_slo_window_bad",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, w.get("bad_availability", 0))
+                p.sample("glint_gang_slo_window_bad",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, w.get("bad_latency", 0))
+        p.head("glint_gang_slo_burn_rate", "gauge",
+               "Error-budget burn rate per SLI and window over the "
+               "merged fleet traffic (1.0 = burning exactly the "
+               "budget).")
+        for ep, doc in slo_eps.items():
+            burns = doc.get("burn_rates") or {}
+            for win, rate in (burns.get("availability") or {}).items():
+                p.sample("glint_gang_slo_burn_rate",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, rate)
+            for win, rate in (burns.get("latency") or {}).items():
+                p.sample("glint_gang_slo_burn_rate",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, rate)
+        p.head("glint_gang_slo_fast_burn", "gauge",
+               "Fleet-level multi-window fast-burn alert (5m AND 1h "
+               "over 14.4x).")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_gang_slo_fast_burn", {"endpoint": ep},
+                     1 if alerts.get("fast_burn") else 0)
+        p.head("glint_gang_slo_slow_burn", "gauge",
+               "Fleet-level multi-window slow-burn alert (30m AND 6h "
+               "over 6x).")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_gang_slo_slow_burn", {"endpoint": ep},
+                     1 if alerts.get("slow_burn") else 0)
     return p.text()
 
 
@@ -514,8 +676,14 @@ def serving_to_prometheus(snap: dict) -> str:
                      {"path": path, "quantile": q}, ep[key] / 1e3)
         p.sample("glint_serving_request_latency_seconds_sum",
                  {"path": path}, ep["mean_ms"] * ep["count"] / 1e3)
+        # Exemplar: the last kept request trace on this endpoint, so a
+        # latency quantile on a dashboard links to a concrete trace.
+        ex = ep.get("exemplar") or {}
         p.sample("glint_serving_request_latency_seconds_count",
-                 {"path": path}, ep["count"])
+                 {"path": path}, ep["count"],
+                 exemplar=({"trace_id": ex.get("trace_id"),
+                            "value": (ex.get("value_ms") or 0) / 1e3}
+                           if ex.get("trace_id") else None))
     sizes = {int(k): int(v)
              for k, v in snap.get("coalesced_batch_sizes", {}).items()}
     p.head("glint_serving_coalesced_batch_size", "histogram",
@@ -666,6 +834,77 @@ def serving_to_prometheus(snap: dict) -> str:
            "live tables (staleness; NaN without an index).")
     p.sample("glint_index_table_versions_behind", None,
              index.get("table_versions_behind"))
+    slo = snap.get("slo") or {}
+    if slo:
+        # SLO burn-rate families (ISSUE 18): per-endpoint objectives,
+        # rolling-window good/bad counts, and the multi-window burn
+        # rates + alerts from obs/slo.SloEngine. Rendered only when a
+        # SLO engine is attached, so bare ServingMetrics snapshots keep
+        # their exposition unchanged.
+        slo_eps = slo.get("endpoints") or {}
+        p.head("glint_slo_availability_target", "gauge",
+               "Availability objective (success-ratio target) per "
+               "tracked endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_slo_availability_target",
+                     {"endpoint": ep}, doc.get("availability_target"))
+        p.head("glint_slo_latency_target", "gauge",
+               "Latency objective (fraction of good requests under the "
+               "threshold) per tracked endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_slo_latency_target",
+                     {"endpoint": ep}, doc.get("latency_target"))
+        p.head("glint_slo_latency_threshold_ms", "gauge",
+               "Latency SLI threshold in milliseconds per endpoint.")
+        for ep, doc in slo_eps.items():
+            p.sample("glint_slo_latency_threshold_ms",
+                     {"endpoint": ep}, doc.get("latency_threshold_ms"))
+        p.head("glint_slo_window_requests", "gauge",
+               "Requests observed in each rolling SLO window.")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_slo_window_requests",
+                         {"endpoint": ep, "window": win},
+                         w.get("total", 0))
+        p.head("glint_slo_window_bad", "gauge",
+               "SLI-violating requests in each rolling window, by SLI "
+               "(availability = 5xx; latency = slower than threshold).")
+        for ep, doc in slo_eps.items():
+            for win, w in (doc.get("windows") or {}).items():
+                p.sample("glint_slo_window_bad",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, w.get("bad_availability", 0))
+                p.sample("glint_slo_window_bad",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, w.get("bad_latency", 0))
+        p.head("glint_slo_burn_rate", "gauge",
+               "Error-budget burn rate per SLI and window (1.0 = "
+               "burning exactly the budget; 14.4 = the fast-burn page "
+               "threshold).")
+        for ep, doc in slo_eps.items():
+            burns = doc.get("burn_rates") or {}
+            for win, rate in (burns.get("availability") or {}).items():
+                p.sample("glint_slo_burn_rate",
+                         {"endpoint": ep, "sli": "availability",
+                          "window": win}, rate)
+            for win, rate in (burns.get("latency") or {}).items():
+                p.sample("glint_slo_burn_rate",
+                         {"endpoint": ep, "sli": "latency",
+                          "window": win}, rate)
+        p.head("glint_slo_fast_burn", "gauge",
+               "Multi-window fast-burn alert (5m AND 1h windows both "
+               "over 14.4x): page-severity budget burn.")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_slo_fast_burn", {"endpoint": ep},
+                     1 if alerts.get("fast_burn") else 0)
+        p.head("glint_slo_slow_burn", "gauge",
+               "Multi-window slow-burn alert (30m AND 6h windows both "
+               "over 6x): ticket-severity budget burn.")
+        for ep, doc in slo_eps.items():
+            alerts = doc.get("alerts") or {}
+            p.sample("glint_slo_slow_burn", {"endpoint": ep},
+                     1 if alerts.get("slow_burn") else 0)
     return p.text()
 
 
@@ -880,9 +1119,12 @@ def fleet_to_prometheus(doc: dict) -> str:
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+_VALUE = r"(?:NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)"
 _SAMPLE_RE = re.compile(
     rf"^({_NAME})(\{{{_LABEL}(?:,{_LABEL})*\}})?"
-    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)$"
+    rf" ({_VALUE})"
+    # Optional OpenMetrics-style exemplar: " # {labels} value".
+    rf"( # \{{{_LABEL}(?:,{_LABEL})*\}} {_VALUE})?$"
 )
 _COMMENT_RE = re.compile(rf"^# (HELP|TYPE) ({_NAME})( .*)?$")
 _TYPES = ("counter", "gauge", "histogram", "summary", "untyped")

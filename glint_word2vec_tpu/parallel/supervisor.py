@@ -309,6 +309,13 @@ class Supervisor:
         self.kill_grace_seconds = float(kill_grace_seconds)
         self._procs: List[Optional[subprocess.Popen]] = []
         self._logs: List = []
+        #: Per-generation gang trace id, minted at each launch and
+        #: exported to every rank as ``GLINT_TRACE_ID``. The workers'
+        #: EventRecorders stamp it into their clock-anchor lines, so
+        #: ``cli trace-merge`` can tie one generation's rank rings (and
+        #: the exchange-round spans inside them) to one gang-wide id;
+        #: postmortem bundles carry it in meta.json.
+        self._gen_trace_id: Optional[str] = None
         #: Merged gang observability endpoint (ISSUE 8). Bound in the
         #: constructor so callers know the port before run() blocks.
         self.gang_server = None
@@ -348,8 +355,14 @@ class Supervisor:
         return snap
 
     def _launch(self, generation: int) -> None:
+        from glint_word2vec_tpu.obs import events as obs_events
+
         os.makedirs(self.status_dir, exist_ok=True)
         port = free_port()
+        # One trace id per generation, shared by every rank: the gang
+        # analogue of the balancer-minted request id. A restart mints a
+        # fresh id, so cross-generation events never stitch together.
+        self._gen_trace_id = obs_events.mint_trace_id()
         self._procs, self._logs = [], []
         for rank in range(self.num_workers):
             sf = self._status_file(rank)
@@ -361,6 +374,7 @@ class Supervisor:
             env.update(self.env)
             env["GLINT_SUPERVISOR"] = "1"
             env["GLINT_SUPERVISOR_GEN"] = str(generation)
+            env["GLINT_TRACE_ID"] = self._gen_trace_id or ""
             if generation == 0:
                 env.update(self.rank_env_first_launch.get(rank, {}))
             argv = self.build_argv(
@@ -521,6 +535,7 @@ class Supervisor:
                     "generation": generation,
                     "rank": rank,
                     "reason": reason,
+                    "trace": self._gen_trace_id,
                     "collected_at": time.time(),
                 })
             except OSError as e:

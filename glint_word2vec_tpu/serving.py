@@ -77,7 +77,13 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from glint_word2vec_tpu.obs import events as obs_events
 from glint_word2vec_tpu.obs.prometheus import serving_to_prometheus
+from glint_word2vec_tpu.obs.slo import (
+    FlightRecorder,
+    ShedBurstDetector,
+    SloEngine,
+)
 from glint_word2vec_tpu.utils import faults, next_pow2
 from glint_word2vec_tpu.utils.metrics import ServingMetrics
 
@@ -262,16 +268,21 @@ class _SynonymCoalescer:
             return self._cache.get((word, int(num), mode))
 
     def query(self, word=None, vector=None, num: int = 10,
-              deadline: Optional[float] = None, exact: bool = False):
+              deadline: Optional[float] = None, exact: bool = False,
+              trace=None):
+        tr = trace if trace is not None else obs_events.NULL_TRACE
         if not self.can_batch:
             # Overriding families define their own semantics end to end
             # (FastText OOV-by-subwords, its own num validation).
-            if not self._acquire_device(deadline):
+            with tr.phase("req.queue"):
+                acquired = self._acquire_device(deadline)
+            if not acquired:
                 raise DeadlineExceeded("deadline waiting for device")
             try:
-                if word is not None:
-                    return self.model.find_synonyms(word, num)
-                return self.model.find_synonyms_vector(vector, num)
+                with tr.phase("req.query", mode="exact"):
+                    if word is not None:
+                        return self.model.find_synonyms(word, num)
+                    return self.model.find_synonyms_vector(vector, num)
             finally:
                 self.device_lock.release()
         if num <= 0:
@@ -304,6 +315,11 @@ class _SynonymCoalescer:
             "event": threading.Event(), "result": None, "error": None,
             "deadline": deadline, "abandoned": False,
             "mode": mode, "exact_requested": bool(exact),
+            # Tracing (ISSUE 18): the leader stamps dispatch-window
+            # perf_counter() pairs onto the dict; THIS waiter thread
+            # converts them into queue/query/readback phases below.
+            "trace": tr.trace_id if trace is not None else None,
+            "t_enq": time.perf_counter(),
         }
         with self._mu:
             self._pending.append(req)
@@ -358,6 +374,16 @@ class _SynonymCoalescer:
             if req["abandoned"]:
                 raise DeadlineExceeded("deadline waiting for dispatch")
             req["event"].wait()
+        if req.get("t_dis0") is not None:
+            # Leader-stamped dispatch window -> this request's phases:
+            # queue wait (enqueue to leader drain), the device query
+            # window, and the host materialization tail.
+            tr.add_phase("req.queue", req["t_enq"],
+                         req["t_dis0"] - req["t_enq"])
+            tr.add_phase("req.query", req["t_dis0"],
+                         req["t_dis1"] - req["t_dis0"], mode=mode)
+            tr.add_phase("req.readback", req["t_dis1"],
+                         req["t_rb1"] - req["t_dis1"])
         if req["error"] is not None:
             raise req["error"]
         return req["result"]
@@ -448,21 +474,33 @@ class _SynonymCoalescer:
         # dispatch these results are from the old tables and must not
         # enter the cache under the new version.
         ver = m.engine.table_version
-        word_rows = [r for r in chunk if "idx" in r]
-        if word_rows:
-            pulled = _pull_coalesced(
-                m.engine,
-                np.asarray([r["idx"] for r in word_rows], np.int32),
+        # Device lane (ISSUE 18): one always-recorded span per coalesced
+        # dispatch (never tail-sampled — a kept request's stitched trace
+        # must always show the batch it rode in; the trace ids it
+        # carried are on the args).
+        t_dis0 = time.perf_counter()
+        with obs_events.phase_span(
+            "req.dispatch", batch=len(chunk), mode=mode,
+            traces=[r["trace"] for r in chunk if r.get("trace")],
+        ):
+            word_rows = [r for r in chunk if "idx" in r]
+            if word_rows:
+                pulled = _pull_coalesced(
+                    m.engine,
+                    np.asarray([r["idx"] for r in word_rows], np.int32),
+                )
+                for r, v in zip(word_rows, pulled):
+                    r["vec"] = v
+            k = max(
+                r["num"] + (1 if r["word"] is not None else 0)
+                for r in chunk
             )
-            for r, v in zip(word_rows, pulled):
-                r["vec"] = v
-        k = max(
-            r["num"] + (1 if r["word"] is not None else 0) for r in chunk
-        )
-        hits = m.find_synonyms_batch(
-            np.stack([r["vec"] for r in chunk]), min(k, m.vocab.size),
-            approximate=(mode == "ann"),
-        )
+            hits = m.find_synonyms_batch(
+                np.stack([r["vec"] for r in chunk]),
+                min(k, m.vocab.size),
+                approximate=(mode == "ann"),
+            )
+        t_dis1 = time.perf_counter()
         if self.metrics is not None:
             self.metrics.record_batch(len(chunk))
             if mode == "ann":
@@ -487,6 +525,12 @@ class _SynonymCoalescer:
             if r["word"] is not None:
                 hs = [(w, s) for w, s in hs if w != r["word"]]
             r["result"] = hs[: r["num"]]
+        t_rb1 = time.perf_counter()
+        for r in chunk:
+            # Dispatch-window stamps the waiter threads convert into
+            # their own queue/query/readback phases (single-writer per
+            # trace: only the owning waiter touches its RequestTrace).
+            r["t_dis0"], r["t_dis1"], r["t_rb1"] = t_dis0, t_dis1, t_rb1
         if self.cache_size:
             with self._mu:
                 if self._cache_sync_locked() != ver:
@@ -742,6 +786,16 @@ class ModelServer:
         # can see how long the device has been continuously busy.
         self._lock = _TrackedLock()
         self.metrics = ServingMetrics()
+        # -- SLO burn rates + anomaly flight recorder (ISSUE 18) -------
+        #: Per-endpoint availability/latency objectives over the device
+        #: paths; ServingMetrics.observe feeds it and its snapshot rides
+        #: /metrics under "slo" (rendered as glint_slo_*).
+        self.metrics.slo = SloEngine.default_serving(_DEVICE_PATHS)
+        self._shed_burst = ShedBurstDetector()
+        #: Optional postmortem bundle writer — installed by
+        #: :meth:`enable_flight_recorder`; None keeps every trigger
+        #: path a no-op.
+        self.flight: Optional[FlightRecorder] = None
         # -- overload protection (ISSUE 7) -----------------------------
         #: Admission high-water mark: device-touching requests past this
         #: many in flight shed with 429 + Retry-After instead of
@@ -822,15 +876,17 @@ class ModelServer:
                 logger.debug("serve: " + fmt, *args)
 
             def _send(self, code: int, obj, headers=None) -> None:
-                body = json.dumps(obj).encode()
-                self._status = code
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
+                tr = getattr(self, "_trace", None) or obs_events.NULL_TRACE
+                with tr.phase("req.serialize"):
+                    body = json.dumps(obj).encode()
+                    self._status = code
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
 
             def _send_text(self, code: int, text: str) -> None:
                 body = text.encode()
@@ -847,6 +903,10 @@ class ModelServer:
             def do_GET(self):
                 t0 = time.perf_counter()
                 self._status = 500
+                # No request trace on GETs (probe/scrape traffic), and a
+                # finished trace from an earlier POST on this keep-alive
+                # connection must not collect this response's spans.
+                self._trace = None
                 # Parsed path: routing and metric keys must not vary with
                 # the query string (?format=... would otherwise mint a
                 # fresh latency histogram per variant).
@@ -898,10 +958,30 @@ class ModelServer:
                             self._send_text(200, serving_to_prometheus(snap))
                         else:
                             self._send(200, snap)
+                    elif url.path == "/trace":
+                        # Flight-recorder scrape: the last N seconds of
+                        # this process's span ring plus the clock anchor,
+                        # so the balancer's postmortem bundle can rebase
+                        # every replica onto one timeline.
+                        rec = obs_events.get_recorder()
+                        try:
+                            secs = float(parse_qs(url.query).get(
+                                "seconds", ["30"]
+                            )[0])
+                        except ValueError:
+                            secs = 30.0
+                        if rec is None:
+                            self._send(200, {"events": [], "anchor": None})
+                        else:
+                            self._send(200, {
+                                "events": rec.recent_events(secs),
+                                "anchor": {"wall_t0": rec.wall_t0,
+                                           "mono_t0": rec.mono_t0},
+                            })
                     else:
                         self._send(404, {"error": f"no route {url.path}"})
                 finally:
-                    server.metrics.observe(
+                    server._observe_request(
                         url.path, time.perf_counter() - t0, self._status
                     )
 
@@ -911,11 +991,23 @@ class ModelServer:
                 # Same parsed-path rule as do_GET: routing and metric
                 # keys must not vary with the query string.
                 path = urlparse(self.path).path
+                # Distributed tracing (ISSUE 18): adopt the propagated
+                # trace id (the balancer's X-Glint-Trace) or mint one at
+                # the edge. Phase spans buffer on the trace and flush
+                # into the ring only if the tail sampler keeps the
+                # request (always: errors/sheds/slow; 1-in-N otherwise).
+                tr = obs_events.request_trace(
+                    self.headers.get(obs_events.TRACE_HEADER)
+                )
+                self._trace = tr
                 try:
-                    self._handle_post(path)
+                    with tr.phase("req.accept", path=path):
+                        self._handle_post(path)
                 finally:
-                    server.metrics.observe(
-                        path, time.perf_counter() - t0, self._status
+                    kept = tr.finish(self._status)
+                    server._observe_request(
+                        path, time.perf_counter() - t0, self._status,
+                        trace_id=tr.trace_id if kept else None,
                     )
 
             def _handle_post(self, path):
@@ -929,8 +1021,11 @@ class ModelServer:
                     # request sheds NOW — cheaper for everyone than
                     # joining a queue whose wait already exceeds any
                     # reasonable client timeout.
-                    if not server._admit():
-                        server.metrics.record_shed("admission")
+                    with self._trace.phase("req.admission") as adm:
+                        admitted = server._admit()
+                        adm.update(admitted=admitted)
+                    if not admitted:
+                        server._record_shed("admission")
                         return self._send(
                             429,
                             {"error": "server overloaded "
@@ -1047,7 +1142,7 @@ class ModelServer:
                             return self._send(
                                 200, [[w, float(s)] for w, s in hit]
                             )
-                    server.metrics.record_shed("degraded")
+                    server._record_shed("degraded")
                     return self._send(
                         429,
                         {"error": "degraded cache-only mode "
@@ -1067,6 +1162,7 @@ class ModelServer:
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
                                 exact=bool(req.get("exact", False)),
+                                trace=self._trace,
                             )
                         ]
                     elif path == "/synonyms_vector":
@@ -1077,21 +1173,26 @@ class ModelServer:
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
                                 exact=bool(req.get("exact", False)),
+                                trace=self._trace,
                             )
                         ]
                     else:
-                        if deadline is None:
-                            acquired = server._lock.acquire()
-                        else:
-                            acquired = server._lock.acquire(
-                                timeout=deadline - time.monotonic()
-                            )
+                        with self._trace.phase("req.queue"):
+                            if deadline is None:
+                                acquired = server._lock.acquire()
+                            else:
+                                acquired = server._lock.acquire(
+                                    timeout=deadline - time.monotonic()
+                                )
                         if not acquired:
                             raise DeadlineExceeded(
                                 "deadline waiting for device"
                             )
                         try:
-                            out = server._dispatch(path, req)
+                            with self._trace.phase(
+                                "req.query", mode="exact"
+                            ):
+                                out = server._dispatch(path, req)
                         finally:
                             server._lock.release()
                 except DeadlineExceeded as e:
@@ -1294,6 +1395,61 @@ class ModelServer:
                 self._degraded_flag = False
         return d
 
+    # -- SLO + anomaly flight recorder (ISSUE 18) ---------------------
+
+    def _observe_request(self, path: str, seconds: float, status: int,
+                         trace_id: Optional[str] = None) -> None:
+        """Single funnel for per-request accounting: the latency
+        histogram + SLO observation (with the exemplar trace id when
+        the tail sampler kept the trace), then the SLO fast-burn
+        flight-recorder trigger (throttled inside the engine)."""
+        self.metrics.observe(
+            path, seconds, status=status, trace_id=trace_id
+        )
+        fl, slo = self.flight, self.metrics.slo
+        if fl is not None and slo is not None:
+            for ep in slo.fast_burn_transitions():
+                fl.trigger("slo_fast_burn", endpoint=ep)
+
+    def _record_shed(self, reason: str) -> None:
+        """Count one shed and fire the flight recorder on the burst
+        EDGE (one bundle per burst, not one per shed)."""
+        self.metrics.record_shed(reason)
+        if self._shed_burst.note() and self.flight is not None:
+            self.flight.trigger("shed_burst", reason=reason)
+
+    def enable_flight_recorder(
+        self, out_dir: str, *, window_seconds: float = 30.0,
+        min_interval_seconds: float = 60.0,
+    ) -> FlightRecorder:
+        """Install the anomaly flight recorder: on a shed burst or an
+        SLO fast-burn edge it bundles this process's recent span ring
+        and full metrics snapshot into ``out_dir`` for postmortem."""
+        fl = FlightRecorder(
+            out_dir, window_seconds=window_seconds,
+            min_interval_seconds=min_interval_seconds,
+        )
+        fl.add_source("spans", self._flight_spans)
+        fl.add_source("metrics", self._flight_metrics)
+        self.flight = fl
+        return fl
+
+    def _flight_spans(self, window_seconds: float) -> dict:
+        rec = obs_events.get_recorder()
+        if rec is None:
+            return {"events": [], "anchor": None}
+        return {
+            "events": rec.recent_events(window_seconds),
+            "anchor": {"wall_t0": rec.wall_t0, "mono_t0": rec.mono_t0},
+        }
+
+    def _flight_metrics(self, window_seconds: float) -> dict:
+        return self.metrics.snapshot(
+            self._query_compiles(),
+            checkpoint=self._checkpoint_stats(),
+            index_staleness=self._index_staleness(),
+        )
+
     # -- warmup / compile accounting ----------------------------------
 
     def _checkpoint_stats(self) -> dict:
@@ -1438,6 +1594,8 @@ def serve_model_dir(
     ann_recall_gate: float = 0.95,
     ann_recall_sample: int = 64,
     port_file: Optional[str] = None,
+    trace_log: Optional[str] = None,
+    flight_dir: Optional[str] = None,
 ) -> None:
     """Load a saved model (any family) and serve it until killed.
 
@@ -1447,9 +1605,17 @@ def serve_model_dir(
     hot-swaps in under load. ``port_file`` writes the bound
     ``{"host", "port"}`` atomically once the server is warmed and
     listening — the fleet launcher's (and CI's) readiness barrier for
-    ``--port 0`` ephemeral replicas."""
+    ``--port 0`` ephemeral replicas. ``trace_log`` installs a
+    process-wide event recorder with a size-rotated JSONL sink (the
+    per-replica half of distributed request tracing: ``cli
+    trace-merge`` stitches these across processes); ``flight_dir``
+    arms the anomaly flight recorder."""
     from glint_word2vec_tpu import load_model
 
+    if trace_log:
+        obs_events.set_recorder(
+            obs_events.EventRecorder(jsonl_path=trace_log)
+        )
     current = None
     model = None
     if model_dir is None:
@@ -1518,6 +1684,8 @@ def serve_model_dir(
         ann_recall_gate=ann_recall_gate,
         ann_recall_sample=ann_recall_sample,
     )
+    if flight_dir:
+        server.enable_flight_recorder(flight_dir)
     if watch_dir is not None:
         server.watch(watch_dir, poll_seconds=watch_poll, current=current)
     elif current is not None:

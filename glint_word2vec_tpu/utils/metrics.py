@@ -13,6 +13,7 @@ from __future__ import annotations
 import bisect
 import contextlib
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -291,6 +292,11 @@ class StepTimeLedger:
         self._seconds = {p: 0.0 for p in LEDGER_PHASES}
         self._counts = {p: 0 for p in LEDGER_PHASES}
         self._hists = {p: LatencyHistogram() for p in LEDGER_PHASES}
+        #: Gang trace id (supervisor-minted, one per launch generation,
+        #: ISSUE 18): rides the snapshot so the training/gang Prometheus
+        #: steptime histograms can carry it as an exemplar pointing at
+        #: the merged gang trace.
+        self.trace_id: Optional[str] = os.environ.get("GLINT_TRACE_ID")
 
     def account(self, phase: str, seconds: float) -> None:
         with self._mu:
@@ -338,12 +344,15 @@ class StepTimeLedger:
                 if include_hists:
                     info["hist"] = self._hists[p].state()
                 phases[p] = info
-            return {
+            out = {
                 "wall_seconds": round(wall, 4),
                 "accounted_seconds": round(accounted, 4),
                 "unattributed_seconds": round(gap, 4),
                 "phases": phases,
             }
+            if self.trace_id:
+                out["trace_id"] = self.trace_id
+            return out
 
     def dump(self, path: str) -> None:
         """Write the per-run STEPTIME.json artifact (atomic): the phase
@@ -376,6 +385,14 @@ class ServingMetrics:
         self._hist: Dict[str, LatencyHistogram] = {}
         self._errors: Dict[str, int] = {}
         self._batches: Dict[int, int] = {}
+        #: Per-endpoint latency exemplar (ISSUE 18): the latest KEPT
+        #: request trace's id + observed latency, so the Prometheus
+        #: exposition can point a dashboard at a trace that actually
+        #: exists in the span ring (tail sampling guarantees kept ids).
+        self._exemplars: Dict[str, dict] = {}
+        #: Optional obs.slo.SloEngine fed by :meth:`observe` (attached
+        #: by the server, duck-typed here — utils must not import obs).
+        self.slo = None
         #: Engine query-shape compiles at the end of server warmup;
         #: ``snapshot`` reports compiles past this as ``post_warmup``.
         self.warmup_compiles = 0
@@ -423,7 +440,8 @@ class ServingMetrics:
     #: "_other". 64 >> the real endpoint count.
     MAX_PATHS = 64
 
-    def observe(self, path: str, seconds: float, status: int = 200) -> None:
+    def observe(self, path: str, seconds: float, status: int = 200,
+                trace_id: Optional[str] = None) -> None:
         with self._mu:
             h = self._hist.get(path)
             if h is None:
@@ -435,6 +453,16 @@ class ServingMetrics:
             h.record(seconds)
             if status >= 400:
                 self._errors[path] = self._errors.get(path, 0) + 1
+            if trace_id:
+                self._exemplars[path] = {
+                    "trace_id": trace_id,
+                    "value_ms": round(seconds * 1e3, 3),
+                }
+        slo = self.slo
+        if slo is not None:
+            # Outside the metrics lock: the engine has its own (fixed
+            # lock order, no nesting).
+            slo.observe(path, seconds, status)
 
     def record_batch(self, size: int) -> None:
         """One coalesced device dispatch of ``size`` queries."""
@@ -544,6 +572,8 @@ class ServingMetrics:
         (pending_async_saves / last_checkpoint_age_seconds /
         checkpoint_write_seconds); serving a freshly-loaded model reports
         Nones — the keys exist either way so dashboards never branch."""
+        slo = self.slo
+        slo_snap = slo.snapshot() if slo is not None else None
         with self._mu:
             endpoints = {}
             for path, h in sorted(self._hist.items()):
@@ -560,7 +590,10 @@ class ServingMetrics:
                     # replica snapshots exactly (obs.aggregate).
                     "hist": h.state(),
                 }
-            return {
+                ex = self._exemplars.get(path)
+                if ex:
+                    endpoints[path]["exemplar"] = dict(ex)
+            out = {
                 "endpoints": endpoints,
                 "coalesced_batch_sizes": {
                     str(k): v for k, v in sorted(self._batches.items())
@@ -629,6 +662,9 @@ class ServingMetrics:
                     "table_versions_behind": index_staleness,
                 },
             }
+            if slo_snap is not None:
+                out["slo"] = slo_snap
+            return out
 
 
 @contextlib.contextmanager

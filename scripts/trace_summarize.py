@@ -238,7 +238,14 @@ def merge_rank_traces(paths) -> dict:
     ranks = []
     truncated = 0
     for i, path in enumerate(paths):
-        rank, anchor, events = _rank_of(path, i), None, []
+        rank, events = _rank_of(path, i), []
+        # The recorder emits a (monotonic, wall) epoch PAIR: ts zero IS
+        # mono_t0, read at the same instant as wall_t0, so an event's
+        # wall time is wall_t0 + ts/1e6. The anchor is tracked as the
+        # file streams (not just the first line): a sink that rotated
+        # into a fresh anchor — or a `cat events.jsonl.1 events.jsonl`
+        # concatenation — re-anchors every event after the new line.
+        anchor, first_anchor = None, None
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -254,14 +261,22 @@ def merge_rank_traces(paths) -> dict:
                     continue
                 if ev.get("ph") == "M":
                     if ev.get("name") == "clock_anchor":
-                        anchor = float(
-                            (ev.get("args") or {}).get("wall_t0", 0.0)
-                        )
+                        a = ev.get("args") or {}
+                        anchor = {
+                            "wall_t0": float(a.get("wall_t0", 0.0)),
+                            "mono_t0": float(a.get("mono_t0", 0.0)),
+                        }
+                        if a.get("trace"):
+                            anchor["trace"] = a["trace"]
+                        if first_anchor is None:
+                            first_anchor = anchor
                     continue
-                events.append(ev)
-        ranks.append({"rank": rank, "path": path, "anchor": anchor,
-                      "events": events})
-    anchors = [r["anchor"] for r in ranks if r["anchor"] is not None]
+                events.append((ev, anchor))
+        ranks.append({"rank": rank, "path": path,
+                      "anchor": first_anchor, "events": events})
+    anchors = [
+        r["anchor"]["wall_t0"] for r in ranks if r["anchor"] is not None
+    ]
     t0 = min(anchors) if anchors else 0.0
     trace_events, unanchored = [], []
     for r in sorted(ranks, key=lambda r: r["rank"]):
@@ -269,12 +284,13 @@ def merge_rank_traces(paths) -> dict:
             "name": "process_name", "ph": "M", "pid": r["rank"],
             "args": {"name": f"rank {r['rank']}"},
         })
-        shift_us = (
-            (r["anchor"] - t0) * 1e6 if r["anchor"] is not None else 0.0
-        )
         if r["anchor"] is None:
             unanchored.append(r["path"])
-        for ev in r["events"]:
+        for ev, anchor in r["events"]:
+            shift_us = (
+                (anchor["wall_t0"] - t0) * 1e6
+                if anchor is not None else 0.0
+            )
             ev = dict(ev)
             ev["pid"] = r["rank"]
             ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
@@ -285,6 +301,9 @@ def merge_rank_traces(paths) -> dict:
         "otherData": {
             "ranks": sorted(r["rank"] for r in ranks),
             "wall_t0": t0,
+            "anchors": {
+                str(r["rank"]): r["anchor"] for r in ranks
+            },
             "unanchored_files": unanchored,
             "truncated_lines": truncated,
         },

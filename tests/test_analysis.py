@@ -280,16 +280,22 @@ def test_fire_rejects_undeclared_point_when_armed():
 
 
 def test_readme_fault_table_matches_registry():
-    """The README fault-injection table is generated from POINTS."""
+    """The README fault-injection and span tables are generated from
+    their registries (faults.POINTS and obs.events.REQUEST_SPANS)."""
+    from glint_word2vec_tpu.obs.events import REQUEST_SPANS
     from glint_word2vec_tpu.utils import faults
 
     readme = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
-    rows = dict(re.findall(r"^\| `([a-z._]+)` \| (.+?) \|$", readme,
-                           re.MULTILINE))
-    for name, doc in faults.POINTS.items():
-        assert name in rows, f"README table missing point {name}"
+    rows = {
+        name: doc.replace("\\|", "|")  # markdown-escaped pipes in cells
+        for name, doc in re.findall(
+            r"^\| `([a-z._]+)` \| (.+?) \|$", readme, re.MULTILINE)
+    }
+    registry = {**faults.POINTS, **REQUEST_SPANS}
+    for name, doc in registry.items():
+        assert name in rows, f"README table missing entry {name}"
         assert rows[name] == doc, f"README row for {name} drifted"
-    assert set(rows) == set(faults.POINTS)
+    assert set(rows) == set(registry)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,707 @@
+"""End-to-end request tracing, SLO burn-rate engine, and anomaly flight
+recorder (ISSUE 18): tail-based sampling semantics, bounded JSONL sinks
+with fresh clock anchors on rotation, the multi-window burn-rate math
+on a fake clock, flight-recorder bundles (including the breaker-open
+drill through a traced stub fleet), the trace-merge collector's
+cross-process stitching, and the Prometheus renderer edge cases
+(label escaping, non-finite values, empty snapshots, exemplars)."""
+
+import importlib.util
+import itertools
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from glint_word2vec_tpu.fleet import LoadBalancer
+from glint_word2vec_tpu.obs import events as obs_events
+from glint_word2vec_tpu.obs.aggregate import merge_trace_logs
+from glint_word2vec_tpu.obs.events import EventRecorder
+from glint_word2vec_tpu.obs.prometheus import (
+    _esc,
+    _num,
+    fleet_to_prometheus,
+    gang_to_prometheus,
+    lint_prometheus_text,
+    serving_to_prometheus,
+    training_to_prometheus,
+)
+from glint_word2vec_tpu.obs.slo import (
+    FlightRecorder,
+    ShedBurstDetector,
+    SloEngine,
+    SloObjective,
+    merge_slo_snapshots,
+)
+from glint_word2vec_tpu.utils.metrics import ServingMetrics
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_global_recorder():
+    """Tests install process-wide recorders; never leak one."""
+    prev = obs_events.get_recorder()
+    yield
+    obs_events.set_recorder(prev)
+
+
+# ----------------------------------------------------------------------
+# RequestTrace: tail-based sampling
+# ----------------------------------------------------------------------
+
+
+def _trace(rec):
+    return obs_events.request_trace(rec=rec)
+
+
+def test_tail_sampling_drops_fast_ok_requests(tmp_path, monkeypatch):
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 10**9)
+    monkeypatch.setattr(obs_events, "_TRACE_SLOW_MS", 10**9)
+    # Pin the head-sample counter off zero: 0 % N == 0 would keep the
+    # process's very first request regardless of the stride.
+    monkeypatch.setattr(obs_events, "_sample_counter", itertools.count(1))
+    rec = EventRecorder()
+    tr = _trace(rec)
+    with tr.phase("req.accept", path="/synonyms"):
+        with tr.phase("req.query"):
+            pass
+    assert tr.finish(200) is False and tr.kept is False
+    assert rec.events() == []  # buffered spans discarded, not recorded
+
+
+def test_tail_sampling_always_keeps_errors(monkeypatch):
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 10**9)
+    monkeypatch.setattr(obs_events, "_TRACE_SLOW_MS", 10**9)
+    rec = EventRecorder()
+    tr = _trace(rec)
+    with tr.phase("req.accept", path="/x"):
+        pass
+    assert tr.finish(503) is True
+    evs = rec.events()
+    assert len(evs) == 1
+    # Every flushed span carries the trace id; the root span carries
+    # the final status.
+    assert evs[0]["args"]["trace"] == tr.trace_id
+    assert evs[0]["args"]["status"] == 503
+
+
+def test_tail_sampling_keeps_slow_requests(monkeypatch):
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 10**9)
+    monkeypatch.setattr(obs_events, "_TRACE_SLOW_MS", 0.0)
+    rec = EventRecorder()
+    tr = _trace(rec)
+    with tr.phase("req.accept"):
+        pass
+    assert tr.finish(200) is True
+
+
+def test_tail_sampling_keeps_forced_and_sampled(monkeypatch):
+    monkeypatch.setattr(obs_events, "_TRACE_SLOW_MS", 10**9)
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 10**9)
+    rec = EventRecorder()
+    tr = _trace(rec)
+    with tr.phase("req.accept"):
+        pass
+    assert tr.finish(200, force=True) is True
+    # Sample-every-1: every request is head-sampled regardless of
+    # status or latency.
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 1)
+    tr2 = _trace(rec)
+    with tr2.phase("req.accept"):
+        pass
+    assert tr2.finish(200) is True
+
+
+def test_trace_id_adoption_and_minting():
+    # No recorder: a null trace that still CARRIES the id downstream.
+    tr = obs_events.request_trace("abc123", rec=None)
+    assert isinstance(tr, obs_events.NullRequestTrace)
+    assert tr.trace_id == "abc123"
+    with tr.phase("req.hop", replica=0) as hop:
+        hop.update(outcome=200)
+    assert tr.finish(200) is False
+    # No id propagated: the edge mints one.
+    minted = obs_events.request_trace(None, rec=None)
+    assert minted.trace_id and minted.trace_id != "abc123"
+    assert obs_events.NULL_TRACE.trace_id == ""
+
+
+def test_request_span_registry_is_closed():
+    assert set(obs_events.REQUEST_SPANS) == {
+        "req.accept", "req.admission", "req.queue", "req.hop",
+        "req.dispatch", "req.query", "req.readback", "req.serialize",
+    }
+    assert obs_events.TRACE_HEADER.lower() == "x-glint-trace"
+
+
+# ----------------------------------------------------------------------
+# EventRecorder sink: rotation + anchors
+# ----------------------------------------------------------------------
+
+
+def test_sink_rotates_at_size_bound_with_fresh_anchor(tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    rec = EventRecorder(jsonl_path=log, max_sink_bytes=2048)
+    for i in range(200):
+        rec.event("filler", i=i, pad="x" * 40)
+    rec.close()
+    assert rec.sink_rotations >= 1
+    assert os.path.exists(log) and os.path.exists(log + ".1")
+    # Disk stays bounded at ~2 generations of max_sink_bytes.
+    assert os.path.getsize(log) + os.path.getsize(log + ".1") < 3 * 2048
+    for path in (log, log + ".1"):
+        first = json.loads(open(path).readline())
+        assert first["name"] == "clock_anchor" and first["ph"] == "M"
+        # The (monotonic, wall) pair the merge tools rebase with.
+        assert first["args"]["wall_t0"] == rec.wall_t0
+        assert first["args"]["mono_t0"] == rec.mono_t0
+
+
+def test_anchor_carries_gang_trace_id(tmp_path, monkeypatch):
+    monkeypatch.setenv("GLINT_TRACE_ID", "gang777")
+    log = str(tmp_path / "events.jsonl")
+    rec = EventRecorder(jsonl_path=log)
+    rec.close()
+    first = json.loads(open(log).readline())
+    assert first["args"]["trace"] == "gang777"
+
+
+def test_recent_events_window():
+    rec = EventRecorder()
+    rec.event("old")
+    rec.event("new")
+    assert [e["name"] for e in rec.recent_events(60.0)] == ["old", "new"]
+    assert rec.recent_events(0.0) == []
+
+
+# ----------------------------------------------------------------------
+# SLO engine: multi-window burn rates on a fake clock
+# ----------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=100000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_windows_and_fast_burn_alert():
+    clk = FakeClock()
+    eng = SloEngine(
+        [SloObjective("/synonyms", availability_target=0.999,
+                      latency_target=0.99, latency_threshold_ms=250.0)],
+        now_fn=clk,
+    )
+    # 100 requests over ~100s: half 500s — a 500x burn, over every
+    # trigger on both the 5m and 1h windows.
+    for i in range(100):
+        eng.observe("/synonyms", 0.01, 500 if i % 2 else 200)
+        clk.t += 1.0
+    snap = eng.snapshot()
+    ep = snap["endpoints"]["/synonyms"]
+    assert ep["windows"]["5m"]["total"] == 100
+    assert ep["windows"]["5m"]["bad_availability"] == 50
+    assert ep["windows"]["6h"]["total"] == 100
+    assert ep["burn_rates"]["availability"]["5m"] > 14.4
+    assert ep["alerts"]["fast_burn"] is True
+    # Latency SLI is measured over non-5xx only: all good responses
+    # were 10ms, so latency burn stays 0.
+    assert ep["burn_rates"]["latency"]["5m"] == 0.0
+    # Endpoints without an objective are ignored (bounded cardinality).
+    eng.observe("/unknown", 0.01, 500)
+    assert "/unknown" not in eng.snapshot()["endpoints"]
+
+
+def test_slo_latency_sli_and_no_traffic_is_no_alert():
+    clk = FakeClock()
+    eng = SloEngine(
+        [SloObjective("/transform", latency_threshold_ms=50.0)],
+        now_fn=clk,
+    )
+    snap = eng.snapshot()["endpoints"]["/transform"]
+    assert snap["windows"]["5m"]["total"] == 0
+    assert snap["burn_rates"]["availability"]["5m"] == 0.0
+    assert snap["alerts"] == {"fast_burn": False, "slow_burn": False}
+    for _ in range(20):
+        eng.observe("/transform", 0.2, 200)  # 200ms > 50ms threshold
+        clk.t += 1.0
+    ep = eng.snapshot()["endpoints"]["/transform"]
+    assert ep["windows"]["5m"]["bad_latency"] == 20
+    assert ep["burn_rates"]["latency"]["5m"] > 14.4
+    assert ep["alerts"]["fast_burn"] is True
+
+
+def test_slo_fast_burn_transitions_edge_triggered():
+    clk = FakeClock()
+    eng = SloEngine([SloObjective("/synonyms")], now_fn=clk)
+    for _ in range(50):
+        eng.observe("/synonyms", 0.01, 500)
+    clk.t += 10.0
+    assert eng.fast_burn_transitions(min_interval=5.0) == ["/synonyms"]
+    clk.t += 10.0
+    # Still burning, but already reported: no new edge.
+    assert eng.fast_burn_transitions(min_interval=5.0) == []
+    # Throttle: evaluations inside min_interval return nothing.
+    assert eng.fast_burn_transitions(min_interval=5.0) == []
+
+
+def test_merge_slo_snapshots_sums_counts_and_rederives():
+    clk = FakeClock()
+    a = SloEngine([SloObjective("/synonyms")], now_fn=clk)
+    b = SloEngine([SloObjective("/synonyms")], now_fn=clk)
+    for _ in range(30):
+        a.observe("/synonyms", 0.01, 200)
+        b.observe("/synonyms", 0.01, 500)
+    merged = merge_slo_snapshots(
+        [a.snapshot(), None, {}, b.snapshot()]
+    )
+    ep = merged["endpoints"]["/synonyms"]
+    assert ep["windows"]["5m"]["total"] == 60
+    assert ep["windows"]["5m"]["bad_availability"] == 30
+    # Burns re-derived from the SUMMED counts, not averaged.
+    assert ep["burn_rates"]["availability"]["5m"] == pytest.approx(
+        (30 / 60) / 0.001, rel=1e-3
+    )
+    assert ep["alerts"]["fast_burn"] is True
+    assert merge_slo_snapshots([None, {}]) is None
+
+
+# ----------------------------------------------------------------------
+# Shed-burst detector + flight recorder
+# ----------------------------------------------------------------------
+
+
+def test_shed_burst_detector_edge_and_rearm():
+    clk = FakeClock()
+    det = ShedBurstDetector(threshold=3, window_seconds=10.0, now_fn=clk)
+    assert det.note() is False
+    assert det.note() is False
+    assert det.note() is True     # threshold crossed: one trigger
+    assert det.note() is False    # still in the same burst
+    clk.t += 11.0                 # window drains
+    assert det.note() is False    # re-armed, below threshold again
+    assert det.note() is False
+    assert det.note() is True     # next burst fires again
+
+
+def test_flight_recorder_bundle_contents_and_rate_limit(tmp_path):
+    clk = FakeClock()
+    fl = FlightRecorder(str(tmp_path), window_seconds=5.0,
+                        min_interval_seconds=60.0, now_fn=clk)
+    seen = {}
+    fl.add_source("spans", lambda w: (
+        seen.setdefault("w", w),
+        {"events": [{"name": "req.accept"}]},
+    )[1])
+    fl.add_source("broken", lambda w: (_ for _ in ()).throw(
+        RuntimeError("scrape failed")))
+    bundle = fl.trigger("breaker_open", replica=1)
+    assert bundle and os.path.isdir(bundle)
+    assert os.path.basename(bundle) == "flightrec-001-breaker_open"
+    # Sources receive the span window.
+    assert seen["w"] == 5.0
+    meta = json.load(open(os.path.join(bundle, "meta.json")))
+    assert meta["reason"] == "breaker_open"
+    assert meta["context"] == {"replica": 1}
+    assert meta["sources"]["spans"] == "ok"
+    assert meta["sources"]["broken"].startswith("error:")
+    spans = json.load(open(os.path.join(bundle, "spans.json")))
+    assert spans["events"][0]["name"] == "req.accept"
+    assert not os.path.exists(os.path.join(bundle, "broken.json"))
+    # Rate limit: a second trigger inside the interval is suppressed.
+    assert fl.trigger("shed_burst") is None
+    clk.t += 61.0
+    assert fl.trigger("shed_burst") is not None
+    stats = fl.stats()
+    assert stats["triggered_total"] == 2
+    assert stats["suppressed_total"] == 1
+    # A hostile reason cannot escape the bundle directory.
+    clk.t += 61.0
+    odd = fl.trigger("../weird reason!")
+    assert odd and os.path.dirname(odd) == str(tmp_path)
+
+
+# ----------------------------------------------------------------------
+# Prometheus renderers: escaping, non-finite, empty, exemplars, SLO
+# ----------------------------------------------------------------------
+
+
+def test_esc_escapes_prometheus_label_specials():
+    assert _esc('a"b') == 'a\\"b'
+    assert _esc("a\\b") == "a\\\\b"
+    assert _esc("a\nb") == "a\\nb"
+    assert _esc(123) == "123"
+
+
+def test_num_renders_non_finite_as_prometheus_specials():
+    assert _num(float("nan")) == "NaN"
+    assert _num(float("inf")) == "+Inf"
+    assert _num(float("-inf")) == "-Inf"
+    assert _num(True) == "1"
+    assert _num(None) == "NaN"  # missing value renders as absent-data
+    assert float(_num(1.5)) == 1.5
+
+
+@pytest.mark.parametrize("render", [
+    training_to_prometheus, serving_to_prometheus,
+    gang_to_prometheus, fleet_to_prometheus,
+])
+def test_renderers_accept_empty_snapshots(render):
+    text = render({})
+    lint_prometheus_text(text)
+    assert text.endswith("\n")
+
+
+def test_serving_renderer_escapes_hostile_path_labels():
+    m = ServingMetrics()
+    hostile = '/syn"onyms\\x\nboom'
+    m.observe(hostile, 0.01, status=200)
+    text = serving_to_prometheus(m.snapshot())
+    lint_prometheus_text(text)
+    assert '/syn\\"onyms\\\\x\\nboom' in text
+    assert "\nboom" not in text  # raw newline would tear the line
+
+
+def test_serving_renderer_non_finite_values_lint():
+    m = ServingMetrics()
+    m.observe("/synonyms", 0.01, status=200)
+    snap = m.snapshot()
+    snap["endpoints"]["/synonyms"]["p99_ms"] = float("inf")
+    snap["endpoints"]["/synonyms"]["p95_ms"] = float("nan")
+    text = serving_to_prometheus(snap)
+    lint_prometheus_text(text)
+    assert "+Inf" in text and "NaN" in text
+
+
+def test_latency_exemplar_rendered_with_trace_id():
+    m = ServingMetrics()
+    m.observe("/synonyms", 0.033, status=200, trace_id="feedc0de")
+    snap = m.snapshot()
+    assert snap["endpoints"]["/synonyms"]["exemplar"]["trace_id"] == (
+        "feedc0de"
+    )
+    text = serving_to_prometheus(snap)
+    lint_prometheus_text(text)
+    assert 'trace_id="feedc0de"' in text
+
+
+def test_slo_gauges_in_all_three_renderers():
+    clk = FakeClock()
+    eng = SloEngine([SloObjective("/synonyms")], now_fn=clk)
+    for _ in range(50):
+        eng.observe("/synonyms", 0.01, 500)
+    slo = eng.snapshot()
+    serving_text = serving_to_prometheus({"slo": slo})
+    gang_text = gang_to_prometheus({"slo": slo})
+    training_text = training_to_prometheus({"slo": slo})
+    for text in (serving_text, gang_text, training_text):
+        lint_prometheus_text(text)
+    assert 'glint_slo_burn_rate{endpoint="/synonyms"' in serving_text
+    assert "glint_slo_fast_burn" in serving_text
+    assert "glint_gang_slo_burn_rate" in gang_text
+    assert "glint_training_slo_burn_rate" in training_text
+    # The alert gauge carries the fired state, not just presence.
+    assert (
+        'glint_slo_fast_burn{endpoint="/synonyms"} 1' in serving_text
+    )
+
+
+# ----------------------------------------------------------------------
+# Trace-merge collector: cross-process stitching
+# ----------------------------------------------------------------------
+
+
+def _write_lane(path, wall_t0, events, trace=None):
+    anchor = {"name": "clock_anchor", "ph": "M", "ts": 0, "pid": 1234,
+              "args": {"wall_t0": wall_t0, "mono_t0": 55.5}}
+    if trace:
+        anchor["args"]["trace"] = trace
+    with open(path, "w") as f:
+        f.write(json.dumps(anchor) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_merge_trace_logs_rebases_and_stitches(tmp_path):
+    t0 = 1700000000.0
+    bal = str(tmp_path / "balancer.jsonl")
+    rep = str(tmp_path / "replica-0.jsonl")
+    _write_lane(bal, t0, [
+        {"name": "req.accept", "ph": "X", "ts": 100.0, "dur": 5000.0,
+         "pid": 10, "tid": 1, "args": {"trace": "t1"}},
+    ])
+    # The replica's clock started 1s later: its ts must land INSIDE the
+    # balancer's accept span after rebasing.
+    _write_lane(rep, t0 + 1.0, [
+        {"name": "req.query", "ph": "X", "ts": 50.0, "dur": 200.0,
+         "pid": 20, "tid": 2, "args": {"trace": "t1"}},
+        {"name": "req.query", "ph": "X", "ts": 300.0, "dur": 200.0,
+         "pid": 20, "tid": 2, "args": {"trace": "only-here"}},
+    ])
+    doc = merge_trace_logs([bal, rep])
+    assert doc["displayTimeUnit"] == "ms"
+    other = doc["otherData"]
+    assert other["wall_t0"] == t0
+    assert other["trace_ids"] == 2
+    assert other["stitched_traces"] == 1  # t1 spans both lanes
+    by_name = {}
+    for ev in doc["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    # Per-file process_name metadata for the Perfetto lane labels.
+    lanes = {m["args"]["name"] for m in by_name["process_name"]}
+    assert lanes == {"balancer", "replica-0"}
+    q = by_name["req.query"][0]
+    assert q["ts"] == pytest.approx(1e6 + 50.0)  # +1s rebased to µs
+    # Events come out time-sorted.
+    ts = [e["ts"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    json.loads(json.dumps(doc))  # valid Chrome-trace JSON round trip
+
+
+def test_merge_trace_logs_skips_unanchored_and_torn_lines(tmp_path):
+    good = str(tmp_path / "good.jsonl")
+    _write_lane(good, 1.0, [
+        {"name": "req.accept", "ph": "X", "ts": 1.0, "dur": 2.0,
+         "pid": 1, "tid": 1},
+    ])
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write('{"name": "no_anchor", "ph": "i", "ts": 1.0}\n')
+        f.write('{"torn line')
+    doc = merge_trace_logs([good, bad])
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert names == ["req.accept"]
+    src = doc["otherData"]["sources"]
+    assert "no clock_anchor" in src[bad]
+    assert src[good].startswith("ok")
+
+
+def test_trace_summarize_merge_ranks_consumes_anchor_pair(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "trace_summarize_for_tracing",
+        os.path.join(ROOT, "scripts", "trace_summarize.py"),
+    )
+    ts_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts_mod)
+    e0 = str(tmp_path / "events-0.jsonl")
+    e1 = str(tmp_path / "events-1.jsonl")
+    _write_lane(e0, 10.0, [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "tid": 1},
+    ], trace="gang1")
+    _write_lane(e1, 12.5, [
+        {"name": "b", "ph": "X", "ts": 0.0, "dur": 1.0, "tid": 1},
+    ], trace="gang1")
+    doc = ts_mod.merge_rank_traces([e0, e1])
+    other = doc["otherData"]
+    assert other["wall_t0"] == 10.0
+    # The FULL (monotonic, wall) anchor pair is surfaced per rank, with
+    # the gang trace id the supervisor exported.
+    assert other["anchors"]["0"] == {
+        "wall_t0": 10.0, "mono_t0": 55.5, "trace": "gang1",
+    }
+    b = next(e for e in doc["traceEvents"] if e["name"] == "b")
+    assert b["ts"] == pytest.approx(2.5e6)  # 2.5s skew rebased
+
+
+# ----------------------------------------------------------------------
+# Traced stub fleet: wire propagation, stitching, breaker drill
+# ----------------------------------------------------------------------
+
+_TRACED_STUB = r"""
+import json, os, sys, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, sys.argv[3])
+from glint_word2vec_tpu.obs import events as obs_events
+
+port_file, trace_log = sys.argv[1], sys.argv[2]
+obs_events.set_recorder(obs_events.EventRecorder(jsonl_path=trace_log))
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        rec = obs_events.get_recorder()
+        if self.path == "/healthz":
+            return self._send(200, {"status": "ok",
+                                    "post_warmup_compiles": 0})
+        if self.path.startswith("/trace"):
+            return self._send(200, {
+                "events": rec.recent_events(60.0),
+                "anchor": {"wall_t0": rec.wall_t0,
+                           "mono_t0": rec.mono_t0},
+            })
+        if self.path == "/metrics":
+            return self._send(200, {"endpoints": {},
+                                    "compiles": {"post_warmup": 0}})
+        self._send(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        tr = obs_events.request_trace(
+            self.headers.get(obs_events.TRACE_HEADER)
+        )
+        with tr.phase("req.accept", path=self.path):
+            with tr.phase("req.query", mode="exact"):
+                pass
+        if self.path == "/synonyms":
+            tr.finish(200, force=True)
+            obs_events.get_recorder().flush()
+            return self._send(200, [["w", 0.5]])
+        tr.finish(404, force=True)
+        self._send(404, {})
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    json.dump({"host": "127.0.0.1", "port": httpd.server_address[1]}, f)
+os.replace(tmp, port_file)
+httpd.serve_forever()
+"""
+
+
+def _wait_port_file(path, proc, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            raise RuntimeError(f"stub died rc={proc.returncode}")
+        if time.monotonic() > deadline:
+            raise TimeoutError("stub not ready")
+        time.sleep(0.02)
+    with open(path) as f:
+        info = json.load(f)
+    return f"http://{info['host']}:{info['port']}"
+
+
+def test_traced_fleet_stitches_and_breaker_drill(tmp_path, monkeypatch):
+    """The ISSUE 18 end-to-end drill, jax-free: two subprocess replicas
+    running the REAL tracing machinery behind a real LoadBalancer with
+    its own recorder. Asserts (a) the trace id propagates over the wire
+    and the merged Chrome trace stitches balancer and replica lanes on
+    one id, and (b) a breaker CLOSED->OPEN transition triggers a
+    flight-recorder bundle holding balancer state plus per-replica span
+    and metrics scrapes."""
+    # Deterministic keep on the balancer side (replicas force-keep).
+    monkeypatch.setattr(obs_events, "_TRACE_SAMPLE_EVERY", 1)
+    stub = tmp_path / "traced_stub.py"
+    stub.write_text(_TRACED_STUB)
+    bal_log = str(tmp_path / "balancer.jsonl")
+    rep_logs = [str(tmp_path / f"replica-{i}.jsonl") for i in range(2)]
+    procs, urls = [], []
+    rec = EventRecorder(jsonl_path=bal_log)
+    obs_events.set_recorder(rec)
+    lb = None
+    try:
+        for i in range(2):
+            pf = str(tmp_path / f"r{i}.port")
+            procs.append(subprocess.Popen(
+                [sys.executable, str(stub), pf, rep_logs[i], ROOT]
+            ))
+            urls.append(_wait_port_file(pf, procs[-1]))
+        lb = LoadBalancer(urls, port=0)
+        lb.start_background()
+        flight_dir = str(tmp_path / "flight")
+        fl = lb.enable_flight_recorder(
+            flight_dir, window_seconds=60.0, min_interval_seconds=0.0
+        )
+        for _ in range(4):
+            req = urllib.request.Request(
+                f"http://{lb.host}:{lb.port}/synonyms",
+                data=json.dumps({"word": "w1", "num": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+
+        # -- breaker drill: CLOSED -> OPEN fires exactly one bundle ----
+        b = lb.breakers[1]
+        assert fl.triggered_total == 0
+        b.force_open()
+        assert fl.triggered_total == 1
+        b.force_open()  # already open: no re-trigger spam
+        assert fl.triggered_total == 1
+        bundles = sorted(os.listdir(flight_dir))
+        assert bundles == ["flightrec-001-breaker_open"]
+        bundle = os.path.join(flight_dir, bundles[0])
+        meta = json.load(open(os.path.join(bundle, "meta.json")))
+        assert meta["context"] == {"replica": 1}
+        assert set(meta["sources"]) == {
+            "balancer", "replica_spans", "replica_metrics",
+        }
+        assert all(v == "ok" for v in meta["sources"].values())
+        spans = json.load(
+            open(os.path.join(bundle, "replica_spans.json"))
+        )
+        # Both replicas answered the scrape with their recent spans and
+        # their clock anchor.
+        for i in range(2):
+            doc = spans[f"replica_{i}"]
+            assert "error" not in doc
+            assert doc["trace"]["anchor"]["wall_t0"] > 0
+            assert any(
+                e["name"] == "req.accept" for e in doc["trace"]["events"]
+            )
+        balancer_doc = json.load(
+            open(os.path.join(bundle, "balancer.json"))
+        )
+        assert len(balancer_doc["breakers"]) == 2
+    finally:
+        if lb is not None:
+            lb.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        obs_events.set_recorder(None)
+        rec.close()
+
+    # -- merged trace: one id stitched across balancer + replica lanes -
+    doc = merge_trace_logs([bal_log] + rep_logs)
+    other = doc["otherData"]
+    assert other["stitched_traces"] >= 1
+    assert len(other["sources"]) == 3
+    lanes = {
+        m["args"]["name"] for m in doc["traceEvents"]
+        if m.get("name") == "process_name"
+    }
+    assert lanes == {"balancer", "replica-0", "replica-1"}
+    # Find one stitched request: a balancer req.hop and a replica
+    # req.accept sharing a trace id across different pids.
+    by_trace = {}
+    for ev in doc["traceEvents"]:
+        tid = (ev.get("args") or {}).get("trace")
+        if tid:
+            by_trace.setdefault(tid, []).append(ev)
+    stitched = [
+        evs for evs in by_trace.values()
+        if len({e["pid"] for e in evs}) > 1
+    ]
+    assert stitched
+    names = {e["name"] for e in stitched[0]}
+    assert "req.hop" in names and "req.accept" in names
+    json.loads(json.dumps(doc))
