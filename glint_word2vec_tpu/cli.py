@@ -516,6 +516,63 @@ def _add_query(sub):
                           "the canary and scored for agreement — the "
                           "vienna/berlin + capital-of analogy gates, "
                           "restated as live-vs-candidate checks")
+    dp = p.add_argument_group(
+        "demand-driven fleet (ISSUE 19)",
+        "multi-process balancer data plane (N processes sharing one "
+        "listen port), warm-spare autoscaling driven by shed-rate/p95/"
+        "burn signals, and per-tenant QoS admission at the front door",
+    )
+    dp.add_argument("--balancer-procs", type=int, default=1,
+                    help="balancer process count sharing the listen "
+                         "port (SO_REUSEPORT, falling back to an "
+                         "inherited listener fd); 1 = the classic "
+                         "single in-process balancer (default)")
+    dp.add_argument("--warm-spares", type=int, default=0,
+                    help="extra replicas launched and fully warmed at "
+                         "boot but HELD out of rotation as spares; "
+                         "the autoscaler readmits them under load "
+                         "(scale-up is never a cold boot)")
+    dp.add_argument("--autoscale-interval", type=float, default=0.5,
+                    help="autoscaler policy-evaluation period seconds "
+                         "(default 0.5)")
+    dp.add_argument("--autoscale-up-shed-rate", type=float, default=1.0,
+                    help="fleet shed rate (sheds/sec, QoS sheds "
+                         "included) that counts as scale-up pressure "
+                         "(default 1.0)")
+    dp.add_argument("--autoscale-up-p95-ms", type=float, default=None,
+                    help="forward-path p95 ms that counts as scale-up "
+                         "pressure (default: the SLO latency target, "
+                         "GLINT_SLO_LATENCY_MS or 250)")
+    dp.add_argument("--autoscale-up-window", type=float, default=1.0,
+                    help="seconds pressure must be sustained before a "
+                         "readmit (default 1)")
+    dp.add_argument("--autoscale-down-window", type=float, default=10.0,
+                    help="seconds of idle before a live replica is "
+                         "parked back to spare (default 10)")
+    dp.add_argument("--autoscale-cooldown", type=float, default=5.0,
+                    help="minimum seconds between any two autoscale "
+                         "transitions (default 5)")
+    dp.add_argument("--qos-tenant-rate", type=float, default=None,
+                    help="per-tenant token-bucket refill rate "
+                         "(requests/sec, tenant from X-Glint-Tenant, "
+                         "'default' bucket otherwise); unset = no "
+                         "tenant quotas")
+    dp.add_argument("--qos-tenant-burst", type=float, default=None,
+                    help="per-tenant bucket depth (default 2x rate)")
+    dp.add_argument("--qos-bulk-max-inflight", type=int, default=None,
+                    help="concurrent in-flight cap for the bulk "
+                         "priority class (X-Glint-Priority: bulk); "
+                         "unset = no class cap")
+
+    p = sub.add_parser(
+        "fleet-shard",
+        help="INTERNAL: one balancer data-plane shard of `serve-fleet "
+             "--balancer-procs N` — accepts from the shared fleet "
+             "port, driven by the supervisor over a private control "
+             "channel; never invoke by hand",
+    )
+    p.add_argument("--config", required=True, metavar="FILE",
+                   help="shard config JSON written by the supervisor")
 
     p = sub.add_parser(
         "supervise",
@@ -1071,8 +1128,18 @@ def _run_fit_stream(args) -> int:
     return 0
 
 
+def _run_fleet_shard(args) -> int:
+    """``fleet-shard``: one subprocess shard of the multi-process
+    balancer data plane. Device-free — it proxies bytes."""
+    from glint_word2vec_tpu.fleet import run_balancer_shard
+
+    return run_balancer_shard(args.config)
+
+
 def _run_serve_fleet(args) -> int:
-    from glint_word2vec_tpu.fleet import CanaryConfig, serve_fleet
+    from glint_word2vec_tpu.fleet import (
+        AutoscaleConfig, CanaryConfig, QosConfig, serve_fleet,
+    )
 
     if args.model is None and args.watch_checkpoint is None:
         print(
@@ -1133,6 +1200,26 @@ def _run_serve_fleet(args) -> int:
             top_k=args.canary_top_k,
             probes=probes,
         )
+    qos = None
+    if (args.qos_tenant_rate is not None
+            or args.qos_bulk_max_inflight is not None):
+        qos = QosConfig(
+            tenant_rate=args.qos_tenant_rate,
+            tenant_burst=args.qos_tenant_burst,
+            bulk_max_inflight=args.qos_bulk_max_inflight,
+        )
+    autoscale = None
+    if args.warm_spares > 0:
+        autoscale = AutoscaleConfig(
+            min_live=args.replicas,
+            max_live=args.replicas + args.warm_spares,
+            interval=args.autoscale_interval,
+            up_shed_per_sec=args.autoscale_up_shed_rate,
+            up_p95_ms=args.autoscale_up_p95_ms,
+            up_window_seconds=args.autoscale_up_window,
+            down_window_seconds=args.autoscale_down_window,
+            cooldown_seconds=args.autoscale_cooldown,
+        )
     return serve_fleet(
         args.model,
         replicas=args.replicas,
@@ -1158,6 +1245,10 @@ def _run_serve_fleet(args) -> int:
         replica_env_first_launch=(
             {0: replica0_env} if replica0_env else None
         ),
+        warm_spares=args.warm_spares,
+        autoscale=autoscale,
+        balancer_procs=args.balancer_procs,
+        qos=qos,
     )
 
 
@@ -1207,6 +1298,9 @@ def _run(args) -> int:
         # Likewise device-free: the balancer proxies; only the replica
         # SUBPROCESSES load tables.
         return _run_serve_fleet(args)
+    if args.cmd == "fleet-shard":
+        # One balancer data-plane shard: device-free like its parent.
+        return _run_fleet_shard(args)
     if args.cmd == "trace-merge":
         # Pure file stitching: no devices, no model loads.
         return _run_trace_merge(args)

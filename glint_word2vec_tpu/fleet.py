@@ -68,14 +68,57 @@ from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from glint_word2vec_tpu.obs import events as obs_events
-from glint_word2vec_tpu.obs.slo import FlightRecorder
+from glint_word2vec_tpu.obs.slo import FlightRecorder, SloEngine
 from glint_word2vec_tpu.parallel.supervisor import (
     capped_backoff,
     terminate_process,
 )
 from glint_word2vec_tpu.utils import faults
+from glint_word2vec_tpu.utils.metrics import LatencyHistogram
 
 logger = logging.getLogger(__name__)
+
+#: Device-dispatch paths the balancer tracks per-endpoint latency/SLO
+#: state for on its OWN forward path (mirrors serving._DEVICE_PATHS —
+#: bounded cardinality by construction). QoS admission applies to
+#: these paths only; control routes are never shed.
+_BALANCER_PATHS = (
+    "/synonyms", "/synonyms_vector", "/analogy", "/vector", "/transform",
+)
+
+#: Client headers the balancer interprets (QoS admission) and forwards
+#: to the replica verbatim: tenant identity, priority class, and the
+#: remaining-deadline budget (milliseconds) the replica tightens its
+#: own request deadline with.
+_QOS_WIRE_HEADERS = (
+    ("X-Glint-Tenant", "x-glint-tenant"),
+    ("X-Glint-Priority", "x-glint-priority"),
+    ("X-Glint-Deadline-Ms", "x-glint-deadline-ms"),
+)
+
+
+def _passthrough_headers(headers: dict) -> Optional[dict]:
+    """QoS/deadline headers to forward replica-ward, wire-cased."""
+    out = None
+    for wire, low in _QOS_WIRE_HEADERS:
+        v = headers.get(low)
+        if v:
+            if out is None:
+                out = {}
+            out[wire] = v
+    return out
+
+
+def _parse_retry_after(headers: dict) -> Optional[float]:
+    """Seconds from a (lowercase-keyed) response header dict, or None.
+    Only the delta-seconds form — everything in this stack emits it."""
+    v = headers.get("retry-after") if headers else None
+    if v is None:
+        return None
+    try:
+        return max(0.0, float(v))
+    except (TypeError, ValueError):
+        return None
 
 
 def _read_request(sock, buf: bytearray):
@@ -360,7 +403,8 @@ class _ReplicaConn:
 
     def roundtrip(self, method: str, path: str, body: bytes,
                   retryable: Optional[bool] = None,
-                  trace_id: Optional[str] = None):
+                  trace_id: Optional[str] = None,
+                  extra_headers: Optional[dict] = None):
         """One request/response exchange; returns (status, body,
         header-dict with lowercase keys). Raises on any transport
         error (caller drops the connection and tries the next
@@ -381,6 +425,10 @@ class _ReplicaConn:
             f"{obs_events.TRACE_HEADER}: {trace_id}\r\n"
             if trace_id else ""
         )
+        if extra_headers:
+            trace_hdr += "".join(
+                f"{k}: {v}\r\n" for k, v in extra_headers.items()
+            )
         req = (
             f"{method} {path} HTTP/1.1\r\n{trace_hdr}{self._prefix}"
             f"{len(body)}\r\n\r\n"
@@ -436,6 +484,259 @@ class _ReplicaConn:
             self._sock = None
 
 
+class _BalancerMetrics:
+    """Per-shard forward-path observability: one log-spaced latency
+    histogram + error/count pair per device path, and a small
+    :class:`SloEngine` over the same objectives the replicas use.
+
+    Produces a SERVING-SHAPED snapshot (``endpoints`` + ``slo`` blocks
+    only) so balancer shards fold through
+    :func:`~glint_word2vec_tpu.obs.aggregate.merge_serving_snapshots`
+    exactly like replicas — bucket-exact histogram merge, SLO window
+    counts summed before burn re-derivation, no second code path."""
+
+    def __init__(self, paths=_BALANCER_PATHS):
+        self._mu = threading.Lock()
+        self._paths = frozenset(paths)
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self._errors: Dict[str, int] = {}
+        self.slo = SloEngine.default_serving(paths)
+        # p95 cache for the deadline-aware shed check: recomputed at
+        # most every _P95_TTL seconds — quantile() walks 65 buckets,
+        # too hot for every admission.
+        self._p95: Dict[str, Tuple[float, float]] = {}
+
+    _P95_TTL = 0.5
+
+    def observe(self, path: str, seconds: float, status: int) -> None:
+        if path not in self._paths:
+            return
+        with self._mu:
+            h = self._hists.get(path)
+            if h is None:
+                h = self._hists[path] = LatencyHistogram()
+                self._errors[path] = 0
+            h.record(seconds)
+            if int(status) >= 500:
+                self._errors[path] += 1
+        self.slo.observe(path, seconds, status)
+
+    def p95_ms(self, path: str) -> Optional[float]:
+        """Current p95 for ``path`` in ms (cached ~0.5s); None before
+        any traffic — a deadline cannot be judged infeasible against
+        nothing."""
+        now = time.monotonic()
+        with self._mu:
+            cached = self._p95.get(path)
+            if cached is not None and now - cached[0] < self._P95_TTL:
+                return cached[1]
+            h = self._hists.get(path)
+            if h is None or h.n == 0:
+                return None
+            val = h.quantile(0.95) * 1e3
+            self._p95[path] = (now, val)
+            return val
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            endpoints = {}
+            for path, h in self._hists.items():
+                endpoints[path] = {
+                    "count": h.n,
+                    "errors": self._errors[path],
+                    "p50_ms": round(h.quantile(0.50) * 1e3, 3),
+                    "p95_ms": round(h.quantile(0.95) * 1e3, 3),
+                    "p99_ms": round(h.quantile(0.99) * 1e3, 3),
+                    "mean_ms": round(h.total / max(h.n, 1) * 1e3, 3),
+                    "max_ms": round(h.max * 1e3, 3),
+                    "hist": h.state(),
+                }
+        return {"endpoints": endpoints, "slo": self.slo.snapshot()}
+
+
+@dataclass
+class QosConfig:
+    """QoS admission knobs for the balancer's device paths. Everything
+    defaults to OFF — a fleet without QoS flags behaves exactly as
+    before (deadline headers still propagate to replicas).
+
+    ``tenant_rate``/``tenant_burst``: per-tenant token bucket (req/s,
+    burst tokens) keyed on ``X-Glint-Tenant`` (the ``default`` bucket
+    otherwise); ``bulk_max_inflight`` caps concurrently-forwarded
+    requests in the ``bulk`` priority class (``X-Glint-Priority:
+    bulk``; anything else is ``interactive``). Deadline-aware shedding
+    is armed by the REQUEST (``X-Glint-Deadline-Ms``): a budget that
+    cannot cover the balancer's current p95 for the path is shed
+    immediately with Retry-After instead of occupying a replica slot
+    to time out."""
+
+    tenant_rate: Optional[float] = None
+    tenant_burst: Optional[float] = None
+    bulk_max_inflight: Optional[int] = None
+    #: Distinct tenant buckets tracked; overflow tenants share the
+    #: ``other`` bucket (bounded cardinality on /metrics too).
+    max_tenants: int = 32
+
+
+class _TokenBucket:
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self.t = now
+
+    def take(self, now: float) -> bool:
+        self.tokens = min(
+            self.burst, self.tokens + (now - self.t) * self.rate
+        )
+        self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class _QosDecision:
+    """One admission verdict: ``shed`` is None on admit, else
+    (status, body-obj, retry-after string); an admitted bulk request
+    holds a bulk-inflight slot until :meth:`QosGate.release`."""
+
+    __slots__ = ("shed", "cls", "tenant", "bulk_slot")
+
+    def __init__(self, shed, cls, tenant, bulk_slot=False):
+        self.shed = shed
+        self.cls = cls
+        self.tenant = tenant
+        self.bulk_slot = bulk_slot
+
+
+class QosGate:
+    """Admission control at the fleet edge: deadline feasibility, then
+    per-tenant token buckets, then the bulk-class inflight cap. Sheds
+    are 429 + Retry-After — honest backpressure in the same shape the
+    replicas' bounded admission emits, so clients need one retry
+    policy."""
+
+    def __init__(self, config: QosConfig,
+                 p95_ms: Callable[[str], Optional[float]],
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.config = config
+        self._p95 = p95_ms
+        self._now = now_fn
+        self._mu = threading.Lock()
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._admitted = {"interactive": 0, "bulk": 0}
+        self._shed = {"tenant_quota": 0, "bulk_inflight": 0, "deadline": 0}
+        self._tenant_shed: Dict[str, int] = {}
+        self._bulk_inflight = 0
+        self._bulk_inflight_peak = 0
+
+    def _tenant_key(self, tenant: str) -> str:
+        if tenant in self._buckets or tenant in self._tenant_shed:
+            return tenant
+        tracked = set(self._buckets) | set(self._tenant_shed)
+        if len(tracked) >= self.config.max_tenants:
+            return "other"
+        return tenant
+
+    def _count_shed(self, reason: str, tenant: str) -> None:
+        self._shed[reason] += 1
+        self._tenant_shed[tenant] = self._tenant_shed.get(tenant, 0) + 1
+
+    def admit(self, path: str, headers: dict) -> _QosDecision:
+        cfg = self.config
+        tenant = headers.get("x-glint-tenant") or "default"
+        cls = (
+            "bulk"
+            if headers.get("x-glint-priority", "").lower() == "bulk"
+            else "interactive"
+        )
+        now = self._now()
+        with self._mu:
+            tenant = self._tenant_key(tenant)
+            # Deadline feasibility first: an expired-or-infeasible
+            # budget is shed before it spends a quota token — the
+            # client pays nothing for a request that could only 504.
+            budget_ms = _parse_deadline_ms(headers)
+            if budget_ms is not None:
+                p95 = self._p95(path)
+                if budget_ms <= 0.0 or (
+                        p95 is not None and budget_ms < p95):
+                    self._count_shed("deadline", tenant)
+                    return _QosDecision((
+                        429,
+                        {
+                            "error": "deadline infeasible",
+                            "deadline_ms": budget_ms,
+                            "p95_ms": p95,
+                        },
+                        "1",
+                    ), cls, tenant)
+            if cfg.tenant_rate:
+                b = self._buckets.get(tenant)
+                if b is None:
+                    burst = (
+                        cfg.tenant_burst
+                        if cfg.tenant_burst is not None
+                        else 2.0 * cfg.tenant_rate
+                    )
+                    b = self._buckets[tenant] = _TokenBucket(
+                        cfg.tenant_rate, burst, now
+                    )
+                if not b.take(now):
+                    self._count_shed("tenant_quota", tenant)
+                    retry = max(1.0 / cfg.tenant_rate, 0.05)
+                    return _QosDecision((
+                        429,
+                        {"error": "tenant quota exceeded",
+                         "tenant": tenant},
+                        f"{retry:g}",
+                    ), cls, tenant)
+            if cls == "bulk" and cfg.bulk_max_inflight:
+                if self._bulk_inflight >= cfg.bulk_max_inflight:
+                    self._count_shed("bulk_inflight", tenant)
+                    return _QosDecision((
+                        429,
+                        {"error": "bulk class at capacity",
+                         "max_inflight": cfg.bulk_max_inflight},
+                        "0.1",
+                    ), cls, tenant)
+                self._bulk_inflight += 1
+                if self._bulk_inflight > self._bulk_inflight_peak:
+                    self._bulk_inflight_peak = self._bulk_inflight
+                self._admitted[cls] += 1
+                return _QosDecision(None, cls, tenant, bulk_slot=True)
+            self._admitted[cls] += 1
+            return _QosDecision(None, cls, tenant)
+
+    def release(self, decision: _QosDecision) -> None:
+        if decision.bulk_slot:
+            with self._mu:
+                self._bulk_inflight = max(0, self._bulk_inflight - 1)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "admitted_total": dict(self._admitted),
+                "shed_total": dict(self._shed),
+                "per_tenant_shed_total": dict(self._tenant_shed),
+                "bulk_inflight": self._bulk_inflight,
+                "bulk_inflight_peak": self._bulk_inflight_peak,
+            }
+
+
+def _parse_deadline_ms(headers: dict) -> Optional[float]:
+    v = headers.get("x-glint-deadline-ms")
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
 class LoadBalancer:
     """Round-robin HTTP proxy over serving replicas with per-replica
     circuit breakers, overload-aware retry, and a merged fleet
@@ -459,6 +760,13 @@ class LoadBalancer:
     RESTART_RETRIES = 3
     RESTART_RETRY_BASE = 0.1
 
+    #: When EVERY replica sheds, a replica-advertised Retry-After up to
+    #: this many seconds is honored — back off max(jitter, Retry-After)
+    #: then take ONE more full pass before relaying the shed. Larger
+    #: values are relayed to the client immediately: parking a proxy
+    #: thread for seconds would turn backpressure into queueing.
+    RETRY_AFTER_CAP = 0.5
+
     #: ``replicas`` entries are replaced wholesale (one atomic tuple
     #: store) by ``set_replica_address`` under the lock; the hot-path
     #: readers take a single indexed load of an immutable tuple, where
@@ -477,7 +785,13 @@ class LoadBalancer:
                  breaker_successes: int = 2,
                  breaker_open_seconds: float = 2.0,
                  probe_interval: float = 0.5,
-                 probe_timeout: float = 2.0):
+                 probe_timeout: float = 2.0,
+                 reuse_port: bool = False,
+                 listen_fd: Optional[int] = None,
+                 control: bool = False,
+                 shard_id: int = 0,
+                 proxy_control: Optional[Tuple[str, int]] = None,
+                 qos: Optional[QosConfig] = None):
         self.replicas = [self._parse(u) for u in replica_urls]
         if not self.replicas:
             raise ValueError("at least one replica url required")
@@ -485,6 +799,16 @@ class LoadBalancer:
         self.proxy_timeout = float(proxy_timeout)
         self.probe_interval = max(0.02, float(probe_interval))
         self.probe_timeout = float(probe_timeout)
+        #: Which data-plane process this balancer is (0 = the
+        #: supervisor-resident shard; >= 1 = a ``fleet-shard``
+        #: subprocess sharing the listen port).
+        self.shard_id = int(shard_id)
+        #: (host, port) of the supervisor shard's CONTROL listener:
+        #: shard subprocesses proxy /metrics and /shutdown there — the
+        #: shared data port is not per-process addressable, and only
+        #: the supervisor can render the fleet-merged document or tear
+        #: the whole fleet down.
+        self.proxy_control = proxy_control
         self._mu = threading.Lock()
         self._rr = 0
         self._proxied = [0] * len(self.replicas)
@@ -493,6 +817,13 @@ class LoadBalancer:
         self._exhausted = 0
         self._breaker_skips = 0
         self._restart_retries = 0
+        self._retry_after_honored = 0
+        #: Forward-path latency/SLO state for THIS shard (serving-
+        #: shaped snapshot; shards fold via merge_serving_snapshots).
+        self.metrics = _BalancerMetrics()
+        self.qos = (
+            QosGate(qos, self.metrics.p95_ms) if qos is not None else None
+        )
         self._addr_version = [0] * len(self.replicas)
         self._expected_gen: List[Optional[str]] = [None] * len(self.replicas)
         self._restarting = [False] * len(self.replicas)
@@ -527,15 +858,60 @@ class LoadBalancer:
         # per-response date formatting alone cost more than a whole
         # warmed ANN dispatch, and at N replicas the proxy must stay
         # the cheapest stage in the chain.
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(128)
+        self._reuse_port = bool(reuse_port) and hasattr(
+            socket, "SO_REUSEPORT"
+        )
+        if listen_fd is not None:
+            # SO_REUSEPORT fallback: adopt the listening socket the
+            # parent bound and passed down (pass_fds) — all shards then
+            # accept from ONE shared queue instead of per-socket ones.
+            self._listener = socket.socket(fileno=listen_fd)
+        else:
+            self._listener = socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            )
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+            if self._reuse_port:
+                self._listener.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                )
+            self._listener.bind((host, port))
+            self._listener.listen(128)
         self.host, self.port = self._listener.getsockname()[:2]
+        self._shared_listener = self._reuse_port or listen_fd is not None
+        if self._shared_listener:
+            # stop() cannot rely on the self-connect nudge here: a
+            # connect to a SHARED port may be delivered to a sibling
+            # shard's accept queue. A bounded accept timeout makes the
+            # loop re-check _stop on its own clock instead.
+            self._listener.settimeout(1.0)
+        #: Private per-process control listener (always 127.0.0.1,
+        #: ephemeral): the supervisor addresses ONE shard through it —
+        #: /_shard/snapshot, /_shard/control mirror ops, /_shard/stop —
+        #: which the shared data port cannot do.
+        self._control_listener = None
+        if control:
+            self._control_listener = socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            )
+            self._control_listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+            self._control_listener.bind(("127.0.0.1", 0))
+            self._control_listener.listen(16)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._control_thread: Optional[threading.Thread] = None
         self._prober: Optional[threading.Thread] = None
         self._prev_switch: Optional[float] = None
+
+    @property
+    def control_addr(self) -> Optional[Tuple[str, int]]:
+        if self._control_listener is None:
+            return None
+        return self._control_listener.getsockname()[:2]
 
     # -- data plane ----------------------------------------------------
 
@@ -573,9 +949,17 @@ class LoadBalancer:
         )
 
     def _accept_loop(self) -> None:
+        self._accept_on(self._listener)
+
+    def _control_accept_loop(self) -> None:
+        self._accept_on(self._control_listener)
+
+    def _accept_on(self, listener) -> None:
         while not self._stop.is_set():
             try:
-                conn, _ = self._listener.accept()
+                conn, _ = listener.accept()
+            except socket.timeout:
+                continue  # shared-port shard: bounded re-check of _stop
             except OSError:
                 return  # listener closed by stop()
             threading.Thread(
@@ -587,6 +971,11 @@ class LoadBalancer:
         """One client connection: parse requests with the minimal
         framed reader, route control paths locally, proxy the rest.
         Keep-alive by default (HTTP/1.1); 'Connection: close' honored."""
+        try:
+            faults.fire("fleet.shard_accept")
+        except Exception:
+            sock.close()
+            return
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         buf = bytearray()
         try:
@@ -611,6 +1000,14 @@ class LoadBalancer:
     def _route(self, sock, method: str, path: str, headers: dict,
                body: bytes) -> None:
         url = urlparse(path)
+        if url.path.startswith("/_shard/"):
+            return self._route_shard(sock, method, url.path, body)
+        if self.proxy_control is not None and (
+                (method == "GET" and url.path == "/metrics")
+                or (method == "POST" and url.path == "/shutdown")):
+            # Shard subprocess: the fleet-merged exposition and the
+            # fleet teardown live on the supervisor shard — relay.
+            return self._proxy_to_control(sock, method, path, body)
         if method == "GET" and url.path == "/healthz":
             up, total, states = self.health()
             return self._respond_json(sock, 200 if up else 503, {
@@ -648,6 +1045,21 @@ class LoadBalancer:
             })
             threading.Thread(target=self.stop, daemon=True).start()
             return
+        # QoS admission (device paths only): deadline feasibility,
+        # tenant quota, bulk-class cap — sheds answer here, before a
+        # replica slot or proxy thread is occupied.
+        t0 = time.monotonic()
+        decision = None
+        if self.qos is not None and url.path in _BALANCER_PATHS:
+            decision = self.qos.admit(url.path, headers)
+            if decision.shed is not None:
+                status, obj, retry_after = decision.shed
+                self.metrics.observe(
+                    url.path, time.monotonic() - t0, status
+                )
+                return self._respond_json(
+                    sock, status, obj, retry_after=retry_after
+                )
         # Distributed tracing (ISSUE 18): adopt the client's trace id
         # or mint one at the fleet edge; the balancer hop's root span
         # wraps the whole proxy exchange, and the id rides the wire
@@ -655,16 +1067,116 @@ class LoadBalancer:
         tr = obs_events.request_trace(
             headers.get(obs_events.TRACE_HEADER.lower())
         )
-        with tr.phase("req.accept", path=url.path, hop="balancer"):
-            status, rbody, rheaders = self.forward(
-                method, path, body, trace=tr
-            )
+        try:
+            with tr.phase("req.accept", path=url.path, hop="balancer"):
+                status, rbody, rheaders = self.forward(
+                    method, path, body, trace=tr,
+                    extra_headers=_passthrough_headers(headers),
+                )
+        finally:
+            if decision is not None:
+                self.qos.release(decision)
         tr.finish(status)
+        self.metrics.observe(url.path, time.monotonic() - t0, status)
         self._respond(
             sock, status, rbody,
             rheaders.get("content-type") or "application/json",
             rheaders.get("retry-after"),
         )
+
+    # -- shard control channel (multi-process data plane) --------------
+
+    def _route_shard(self, sock, method: str, path: str,
+                     body: bytes) -> None:
+        """The per-shard control surface the supervisor drives over
+        each shard's private control listener: snapshot (local
+        counters only — never scrapes replicas), breaker/address
+        mirror ops, and stop."""
+        if method == "GET" and path == "/_shard/snapshot":
+            return self._respond_json(sock, 200, self.shard_snapshot())
+        if method == "POST" and path == "/_shard/control":
+            try:
+                op = json.loads(body.decode() or "{}")
+                out = self.apply_control(op)
+            except (ValueError, KeyError, IndexError, TypeError) as e:
+                return self._respond_json(
+                    sock, 400, {"ok": False, "error": str(e)}
+                )
+            return self._respond_json(sock, 200, out)
+        if method == "POST" and path == "/_shard/stop":
+            self._respond_json(sock, 200, {"ok": True, "stopping": True})
+            threading.Thread(target=self.stop, daemon=True).start()
+            return
+        return self._respond_json(sock, 404, {"error": "not found"})
+
+    def shard_snapshot(self) -> dict:
+        """This shard's own data-plane state: balancer counters,
+        breaker views, and the serving-shaped forward-path block the
+        supervisor folds through ``merge_serving_snapshots``."""
+        return {
+            "shard": self.shard_id,
+            "up": True,
+            "stats": self.balancer_stats(),
+            "breakers": [b.snapshot() for b in self.breakers],
+            "serving": self.metrics.snapshot(),
+        }
+
+    def apply_control(self, op: dict) -> dict:
+        """Apply one supervisor mirror op. The supervisor owns the
+        single control plane; shards replicate its address-table and
+        breaker decisions so every data plane routes consistently
+        while breaker STATE (probe verdicts, trip counts) stays
+        per-shard and lock-free."""
+        kind = str(op.get("op") or "")
+        i = int(op.get("i", -1))
+        if not 0 <= i < len(self.replicas):
+            raise IndexError(f"replica index {i} out of range")
+        b = self.breakers[i]
+        if kind == "set_address":
+            self.set_replica_address(
+                i, str(op["host"]), int(op["port"]),
+                generation=op.get("generation"),
+            )
+        elif kind == "set_restarting":
+            self.set_restarting(i, bool(op.get("flag")))
+        elif kind == "hold":
+            b.hold()
+        elif kind == "release":
+            b.release()
+        elif kind == "clear_holds":
+            b.clear_holds()
+        elif kind == "trial":
+            b.trial()
+        elif kind == "force_open":
+            b.force_open()
+        else:
+            raise ValueError(f"unknown control op {kind!r}")
+        return {"ok": True, "op": kind, "i": i}
+
+    def _proxy_to_control(self, sock, method: str, path: str,
+                          body: bytes) -> None:
+        host, port = self.proxy_control
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30.0)
+            try:
+                conn.request(
+                    method, path, body=body or None,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                ctype = resp.getheader(
+                    "Content-Type", "application/json"
+                )
+                status = resp.status
+            finally:
+                conn.close()
+        except OSError as e:
+            return self._respond_json(
+                sock, 503, {"error": f"control plane unreachable: {e}"},
+                retry_after="1",
+            )
+        self._respond(sock, status, data, ctype)
 
     @staticmethod
     def _parse(url: str):
@@ -733,7 +1245,8 @@ class LoadBalancer:
             return self._rr
 
     def _attempt(self, i: int, method: str, path: str, body: bytes,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 extra_headers: Optional[dict] = None):
         """One replica attempt; (status, body, headers) or None on
         connection failure (breaker and error accounting applied). A
         connection-refused inside a known restart window retries the
@@ -743,7 +1256,8 @@ class LoadBalancer:
         for attempt in range(self.RESTART_RETRIES + 1):
             try:
                 return self._conn(i).roundtrip(
-                    method, path, body, trace_id=trace_id
+                    method, path, body, trace_id=trace_id,
+                    extra_headers=extra_headers,
                 )
             except ConnectionRefusedError:
                 self._drop_conn(i)
@@ -764,63 +1278,85 @@ class LoadBalancer:
         self.breakers[i].record_failure()
         return None
 
-    def forward(self, method: str, path: str, body: bytes, trace=None):
+    def forward(self, method: str, path: str, body: bytes, trace=None,
+                extra_headers: Optional[dict] = None):
         """Send one request to the fleet: round-robin start over
         CLOSED breakers, advance on connection failure or a shed
         status (429/503), at most one attempt per replica. Returns
-        (status, body, headers). When every replica sheds, the LAST
-        shed response is relayed — its Retry-After included — so the
-        client sees the fleet's own backpressure, not an invented
-        error. ``trace`` (a ``RequestTrace``) records one ``req.hop``
-        phase span per replica attempt and propagates its id to the
-        replica over the wire header.
+        (status, body, headers). When every replica sheds, a
+        replica-advertised Retry-After within :attr:`RETRY_AFTER_CAP`
+        is HONORED — back off max(jitter, Retry-After), then one more
+        full pass — before the LAST shed response is relayed with its
+        Retry-After intact, so the client sees the fleet's own
+        backpressure, not an invented error. ``trace`` (a
+        ``RequestTrace``) records one ``req.hop`` phase span per
+        replica attempt and propagates its id to the replica over the
+        wire header; ``extra_headers`` ride to the replica verbatim
+        (tenant/priority/deadline propagation).
 
         Open/half-open breakers are skipped (each skip is a timeout a
         client did not pay) and only attempted as a last resort when
         no closed replica answered. Administratively HELD replicas are
-        never attempted: a hold means a rollout drain or a canary
-        serving a CANDIDATE generation that must not touch live
-        traffic."""
+        never attempted: a hold means a rollout drain, a canary
+        serving a CANDIDATE generation, or a warm spare the autoscaler
+        has parked — none may touch live traffic."""
         tr = trace if trace is not None else obs_events.NULL_TRACE
         n = len(self.replicas)
-        start = self._next_start()
-        order = [(start + j) % n for j in range(n)]
-        eligible = [i for i in order if self.breakers[i].eligible()]
-        fallback = [
-            i for i in order
-            if not self.breakers[i].eligible()
-            and not self.breakers[i].held()
-        ]
-        if len(eligible) < n:
-            with self._mu:
-                self._breaker_skips += n - len(eligible)
         last_shed = None
         attempted = 0
-        for i in eligible + fallback:
-            with tr.phase("req.hop", replica=i) as hop:
-                got = self._attempt(
-                    i, method, path, body,
-                    trace_id=tr.trace_id or None,
-                )
-                hop.update(
-                    outcome="conn_error" if got is None else int(got[0])
-                )
-            attempted += 1
-            if got is None:
-                continue
-            status, rbody, rheaders = got
-            # ANY HTTP answer proves the process is alive — a shed is
-            # backpressure, not breakage.
-            self.breakers[i].record_success()
-            if status in _SHED_STATUSES:
-                last_shed = got
+        for round_no in range(2):
+            start = self._next_start()
+            order = [(start + j) % n for j in range(n)]
+            eligible = [i for i in order if self.breakers[i].eligible()]
+            fallback = [
+                i for i in order
+                if not self.breakers[i].eligible()
+                and not self.breakers[i].held()
+            ]
+            if len(eligible) < n:
                 with self._mu:
-                    self._shed_retries += 1
-                continue
-            with self._mu:
-                self._proxied[i] += 1
-            self._maybe_mirror(method, path, body, status, rbody)
-            return got
+                    self._breaker_skips += n - len(eligible)
+            for i in eligible + fallback:
+                with tr.phase("req.hop", replica=i) as hop:
+                    got = self._attempt(
+                        i, method, path, body,
+                        trace_id=tr.trace_id or None,
+                        extra_headers=extra_headers,
+                    )
+                    hop.update(
+                        outcome="conn_error" if got is None
+                        else int(got[0])
+                    )
+                attempted += 1
+                if got is None:
+                    continue
+                status, rbody, rheaders = got
+                # ANY HTTP answer proves the process is alive — a shed
+                # is backpressure, not breakage.
+                self.breakers[i].record_success()
+                if status in _SHED_STATUSES:
+                    last_shed = got
+                    with self._mu:
+                        self._shed_retries += 1
+                    continue
+                with self._mu:
+                    self._proxied[i] += 1
+                self._maybe_mirror(method, path, body, status, rbody)
+                return got
+            if round_no == 0 and last_shed is not None \
+                    and not self._stop.is_set():
+                retry_after = _parse_retry_after(last_shed[2])
+                if retry_after is not None \
+                        and retry_after <= self.RETRY_AFTER_CAP:
+                    with self._mu:
+                        self._retry_after_honored += 1
+                    jitter = (
+                        self.RESTART_RETRY_BASE
+                        * (0.5 + random.random())
+                    )
+                    time.sleep(max(retry_after, jitter))
+                    continue
+            break
         with self._mu:
             self._exhausted += 1
         if last_shed is not None:
@@ -978,14 +1514,18 @@ class LoadBalancer:
 
     def balancer_stats(self) -> dict:
         with self._mu:
-            return {
+            out = {
                 "shed_retries_total": self._shed_retries,
                 "exhausted_total": self._exhausted,
                 "proxied_total": int(sum(self._proxied)),
                 "proxy_errors_total": int(sum(self._errors)),
                 "breaker_skips_total": self._breaker_skips,
                 "restart_retries_total": self._restart_retries,
+                "retry_after_honored_total": self._retry_after_honored,
             }
+        if self.qos is not None:
+            out["qos"] = self.qos.snapshot()
+        return out
 
     def metrics_doc(self) -> dict:
         """The merged fleet document: per-replica snapshots (scraped
@@ -1143,16 +1683,28 @@ class LoadBalancer:
             self._prev_switch = sys.getswitchinterval()
             sys.setswitchinterval(0.001)
 
+    def _start_control(self) -> None:
+        if self._control_listener is None or \
+                self._control_thread is not None:
+            return
+        self._control_thread = threading.Thread(
+            target=self._control_accept_loop, daemon=True,
+            name="glint-fleet-control",
+        )
+        self._control_thread.start()
+
     def serve_forever(self) -> None:
         logger.info(
             "fleet balancer on %s:%d over %d replica(s)",
             self.host, self.port, len(self.replicas),
         )
         self._tighten_gil_switch()
+        self._start_control()
         self._accept_loop()
 
     def start_background(self) -> None:
         self._tighten_gil_switch()
+        self._start_control()
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="glint-fleet-lb",
@@ -1164,21 +1716,46 @@ class LoadBalancer:
         # Waking a thread blocked in accept() needs more than close():
         # on Linux, closing the fd from another thread leaves the
         # accept blocked forever. shutdown() wakes it with EINVAL; the
-        # best-effort self-connect covers platforms where it doesn't.
+        # best-effort self-connect covers platforms where it doesn't —
+        # EXCEPT on a shared (SO_REUSEPORT / inherited-fd) port, where
+        # the kernel may deliver the nudge connection to a SIBLING
+        # shard's queue; those accept loops run with a bounded accept
+        # timeout instead and notice _stop on their own clock.
         try:
             self._listener.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        try:
-            socket.create_connection(
-                (self.host, self.port), timeout=1
-            ).close()
-        except OSError:
-            pass
+        if not self._shared_listener:
+            try:
+                socket.create_connection(
+                    (self.host, self.port), timeout=1
+                ).close()
+            except OSError:
+                pass
         try:
             self._listener.close()
         except OSError:  # pragma: no cover - already closed
             pass
+        if self._control_listener is not None:
+            ctrl_addr = None
+            try:
+                ctrl_addr = self._control_listener.getsockname()[:2]
+                self._control_listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            if ctrl_addr is not None:
+                # The control listener is private (never shared), so
+                # the self-connect nudge is reliable there.
+                try:
+                    socket.create_connection(
+                        ctrl_addr, timeout=1
+                    ).close()
+                except OSError:
+                    pass
+            try:
+                self._control_listener.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
         if self._prev_switch is not None:
             sys.setswitchinterval(self._prev_switch)
             self._prev_switch = None
@@ -1235,6 +1812,87 @@ class CanaryConfig:
         self.probes = list(probes or [])
 
 
+class ReplicaHoldLedger:
+    """The replica-hold ownership protocol the rollout coordinator and
+    the autoscaler share (ISSUE 19): every administrative hold on a
+    replica is owned by a NAMED owner — ``"rollout"`` (drain during a
+    swap, or a canary serving a candidate) or ``"autoscale"`` (a warm
+    spare parked out of rotation) — and applied through one pair of
+    callbacks (ref-counted breaker holds on the supervisor shard,
+    fanned out to every balancer shard by the data-plane facade).
+
+    The protocol:
+      * one hold per (owner, replica) — double-acquire is a no-op;
+      * owners compose: a rollout may drain a PARKED spare (swapping
+        it keeps the spare warm on the promoted generation) and
+        releasing the rollout's hold leaves it parked;
+      * a replica held by anyone besides the autoscaler is NEVER spare
+        capacity (a held canary must not be readmitted by a scale-up);
+      * after a relaunch wipes a replica's breaker holds
+        (``clear_holds`` in supervisor adoption), :meth:`reapply`
+        restores every surviving owner's hold — a parked spare that
+        crashed comes back parked, not serving."""
+
+    def __init__(self, hold: Callable[[int], None],
+                 release: Callable[[int], None],
+                 clear: Optional[Callable[[int], None]] = None):
+        self._hold = hold
+        self._release = release
+        self._clear = clear
+        self._mu = threading.Lock()
+        self._owners: Dict[int, set] = {}
+
+    def acquire(self, owner: str, i: int) -> bool:
+        with self._mu:
+            owners = self._owners.setdefault(i, set())
+            if owner in owners:
+                return False
+            owners.add(owner)
+        self._hold(i)
+        return True
+
+    def release(self, owner: str, i: int) -> bool:
+        with self._mu:
+            owners = self._owners.get(i) or set()
+            if owner not in owners:
+                return False
+            owners.discard(owner)
+        self._release(i)
+        return True
+
+    def owners(self, i: int) -> frozenset:
+        with self._mu:
+            return frozenset(self._owners.get(i) or ())
+
+    def parked(self, owner: str) -> List[int]:
+        """Replicas held by ``owner`` and NOBODY else — the only ones
+        that count as spare capacity when ``owner == "autoscale"``."""
+        with self._mu:
+            return sorted(
+                i for i, owners in self._owners.items()
+                if owners == {owner}
+            )
+
+    def reapply(self, i: int) -> None:
+        """Re-assert every owner's hold on ``i`` after a relaunch
+        cleared the replica's breaker holds."""
+        with self._mu:
+            owners = sorted(self._owners.get(i) or ())
+        if self._clear is not None:
+            self._clear(i)
+        for _ in owners:
+            self._hold(i)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "held": {
+                    str(i): sorted(owners)
+                    for i, owners in self._owners.items() if owners
+                },
+            }
+
+
 class RolloutCoordinator:
     """Orders fleet-wide generation rollouts, one replica at a time.
 
@@ -1266,7 +1924,8 @@ class RolloutCoordinator:
                  step_timeout: float = 600.0,
                  drain_seconds: float = 0.25,
                  replica_ok: Optional[Callable[[int], bool]] = None,
-                 on_generation=None):
+                 on_generation=None,
+                 holds: Optional[ReplicaHoldLedger] = None):
         self.lb = lb
         self.watch_dir = watch_dir
         self.poll_seconds = max(0.05, float(poll_seconds))
@@ -1275,6 +1934,13 @@ class RolloutCoordinator:
         self.drain_seconds = float(drain_seconds)
         self._replica_ok = replica_ok or (lambda i: True)
         self.on_generation = on_generation
+        #: Shared hold-ownership ledger (supervisor-provided when an
+        #: autoscaler coexists); standalone use gets a private ledger
+        #: over this balancer's breakers.
+        self.holds = holds if holds is not None else ReplicaHoldLedger(
+            lambda i: lb.breakers[i].hold(),
+            lambda i: lb.breakers[i].release(),
+        )
         self._mu = threading.Lock()
         #: Generation name the whole fleet serves (None when booted
         #: from a plain --model dir outside the publish protocol).
@@ -1355,7 +2021,9 @@ class RolloutCoordinator:
         # rollout needs the whole (non-written-off) fleet serving, so
         # it halts and retries once the supervisor readmits the
         # replica — never racing a relaunch with a reload.
-        not_ready = [i for i in ok_idx if not lb.breakers[i].eligible()]
+        not_ready = [
+            i for i in ok_idx if not self._ready_for_rollout(i)
+        ]
         if not ok_idx or not_ready:
             return self._halt(
                 gen,
@@ -1408,7 +2076,7 @@ class RolloutCoordinator:
             with self._mu:
                 self._stats["rollout_steps_total"] += 1
                 self._phase = "rolling"
-            if not self._replica_ok(i) or not lb.breakers[i].eligible():
+            if not self._replica_ok(i) or not self._ready_for_rollout(i):
                 # Replica killed mid-rollout: halt — the old generation
                 # keeps serving on every un-swapped replica, and the
                 # next poll retries once the fleet is whole.
@@ -1437,6 +2105,28 @@ class RolloutCoordinator:
             gen, len(ok_idx),
         )
         return gen
+
+    def _ready_for_rollout(self, i: int) -> bool:
+        """A replica is rollable when its breaker is serving-eligible
+        OR it is a healthy warm spare parked ONLY by the autoscaler:
+        spares are swapped too (they must stay warm on the promoted
+        generation, ready for a zero-compile readmit) and must never
+        stall a rollout. Any other hold — a canary carrying a
+        candidate, a drain in progress — still blocks."""
+        b = self.lb.breakers[i]
+        if b.eligible():
+            return True
+        return (
+            b.state() == ReplicaBreaker.CLOSED
+            and self.holds.owners(i) == frozenset(("autoscale",))
+        )
+
+    def in_progress(self) -> bool:
+        """Cheap rollout-pinning flag for the autoscaler: while a
+        rollout (canary phase included) is in flight the replica set
+        is PINNED — no scale transitions may fight the swap order."""
+        with self._mu:
+            return self._in_progress
 
     def _halt(self, gen: str, reason: str) -> None:
         """Transient abort: retried on a later poll (the pointer still
@@ -1543,10 +2233,12 @@ class RolloutCoordinator:
         no peer to absorb traffic, ejecting the only replica would
         drop availability to zero, and the reload stages off the
         request path anyway."""
-        b = self.lb.breakers[i]
         _, compiles_before, _ = self._replica_metrics(i)
         if hold:
-            b.hold()
+            # Through the shared ledger: on a parked spare this stacks
+            # a "rollout" hold on the autoscaler's (ref-counted), and
+            # releasing below leaves the spare parked, not serving.
+            self.holds.acquire("rollout", i)
             time.sleep(self.drain_seconds)  # in-flight requests drain
         try:
             try:
@@ -1571,7 +2263,7 @@ class RolloutCoordinator:
             return self._wait_replica_on(i, gen, compiles_before)
         finally:
             if hold:
-                b.release()
+                self.holds.release("rollout", i)
 
     # -- shadow canary -------------------------------------------------
 
@@ -1610,11 +2302,10 @@ class RolloutCoordinator:
         the candidate generation cannot reach a client until it
         passes."""
         lb = self.lb
-        b = lb.breakers[ci]
         with self._mu:
             self._stats["canary"]["evaluations_total"] += 1
             self._phase = "canary"
-        b.hold()
+        self.holds.acquire("rollout", ci)
         mirroring = False
         restored = True
         try:
@@ -1732,10 +2423,12 @@ class RolloutCoordinator:
             if mirroring:
                 lb.stop_mirror()
             if restored:
-                b.release()
+                self.holds.release("rollout", ci)
             # NOT restored: the canary still holds the regressed
-            # candidate — it stays held (no live traffic) for the
-            # operator; the README runbook documents recovery.
+            # candidate — the "rollout" hold stays in the ledger (no
+            # live traffic, and the autoscaler can never count it as
+            # spare capacity) for the operator; the README runbook
+            # documents recovery.
 
     def _restore_canary(self, ci: int, candidate: str) -> bool:
         """Reload the canary back to the live generation after a
@@ -1807,6 +2500,693 @@ class RolloutCoordinator:
 
 
 # ----------------------------------------------------------------------
+# Multi-process data plane (ISSUE 19): shard subprocesses + facade
+# ----------------------------------------------------------------------
+
+
+def run_balancer_shard(config_path: str) -> int:
+    """Entry point of one ``fleet-shard`` subprocess: a full
+    :class:`LoadBalancer` data plane (own thread pool, per-thread
+    keep-alive replica connections, breakers + prober, EventRecorder
+    sink) accepting from the SHARED fleet port, plus a private control
+    listener the supervisor drives. Exits when stopped over the
+    control channel or when the parent dies (orphan watchdog)."""
+    from glint_word2vec_tpu.utils import atomic_write_json
+
+    with open(config_path) as f:
+        cfg = json.load(f)
+    if cfg.get("trace_log"):
+        obs_events.set_recorder(obs_events.EventRecorder(
+            jsonl_path=cfg["trace_log"],
+        ))
+    replicas = cfg["replicas"]
+    qos_cfg = cfg.get("qos")
+    lb = LoadBalancer(
+        [f"http://{r['host']}:{r['port']}" for r in replicas],
+        host=cfg.get("host", "127.0.0.1"),
+        port=int(cfg.get("port", 0)),
+        reuse_port=bool(cfg.get("reuse_port")),
+        listen_fd=cfg.get("listen_fd"),
+        control=True,
+        shard_id=int(cfg.get("shard", 1)),
+        proxy_control=(
+            tuple(cfg["parent_control"])
+            if cfg.get("parent_control") else None
+        ),
+        qos=QosConfig(**qos_cfg) if qos_cfg else None,
+        proxy_timeout=float(cfg.get("proxy_timeout", 60.0)),
+        scrape_timeout=float(cfg.get("scrape_timeout", 2.0)),
+        breaker_failures=int(cfg.get("breaker_failures", 3)),
+        breaker_successes=int(cfg.get("breaker_successes", 2)),
+        breaker_open_seconds=float(cfg.get("breaker_open_seconds", 2.0)),
+        probe_interval=float(cfg.get("probe_interval", 0.5)),
+        probe_timeout=float(cfg.get("probe_timeout", 2.0)),
+    )
+    for i, r in enumerate(replicas):
+        if r.get("generation") is not None:
+            lb.set_replica_address(
+                i, r["host"], int(r["port"]),
+                generation=r["generation"],
+            )
+        if r.get("held"):
+            lb.breakers[i].hold()
+        if r.get("restarting"):
+            lb.set_restarting(i, True)
+            lb.breakers[i].force_open()
+    atomic_write_json(cfg["port_file"], {
+        "shard": lb.shard_id,
+        "pid": os.getpid(),
+        "host": lb.host,
+        "port": lb.port,
+        "control_host": lb.control_addr[0],
+        "control_port": lb.control_addr[1],
+    })
+    lb.start_background()
+    lb.start_prober()
+    ppid = os.getppid()
+    try:
+        while not lb.stopped():
+            if os.getppid() != ppid:
+                # Parent supervisor died without tearing us down: a
+                # balancer shard must NEVER outlive its fleet.
+                logger.error(
+                    "fleet shard %d: parent died — exiting",
+                    lb.shard_id,
+                )
+                break
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        lb.stop()
+    return 0
+
+
+class _ShardHandle:
+    """The supervisor's view of one shard subprocess: its process and
+    its private control address."""
+
+    def __init__(self, shard_id: int, proc, host: str, port: int,
+                 timeout: float = 5.0):
+        self.shard_id = shard_id
+        self.proc = proc
+        self.host, self.port = host, int(port)
+        self.timeout = float(timeout)
+
+    def _request(self, method: str, path: str, payload=None):
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        try:
+            body = (
+                json.dumps(payload).encode()
+                if payload is not None else None
+            )
+            conn.request(
+                method, path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, json.loads(data.decode() or "null")
+        finally:
+            conn.close()
+
+    def control(self, op: dict) -> bool:
+        try:
+            status, _ = self._request("POST", "/_shard/control", op)
+            return status == 200
+        except Exception as e:
+            logger.warning(
+                "fleet shard %d control op %s failed: %s",
+                self.shard_id, op.get("op"), e,
+            )
+            return False
+
+    def snapshot(self) -> dict:
+        try:
+            status, doc = self._request("GET", "/_shard/snapshot")
+            if status == 200 and isinstance(doc, dict):
+                return doc
+            return {
+                "shard": self.shard_id, "up": False,
+                "error": f"http {status}",
+            }
+        except Exception as e:
+            return {
+                "shard": self.shard_id, "up": False, "error": str(e),
+            }
+
+    def request_stop(self) -> bool:
+        try:
+            status, _ = self._request("POST", "/_shard/stop", {})
+            return status == 200
+        except Exception:
+            return False
+
+
+class BalancerShardManager:
+    """Launches and owns the extra balancer shard subprocesses of a
+    multi-process data plane (``--balancer-procs N`` = the supervisor
+    shard + N-1 of these). Each shard shares the fleet's listen port —
+    SO_REUSEPORT when the platform has it, otherwise the parent-bound
+    listener inherited by fd — and runs its own breakers/prober/
+    thread pool; this manager is purely control plane: config
+    handoff, mirror-op broadcast, snapshot scrape, teardown."""
+
+    def __init__(self, lb: LoadBalancer, count: int, *,
+                 replica_specs: List[dict],
+                 qos: Optional[dict] = None,
+                 trace_dir: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 start_timeout: float = 60.0,
+                 kill_grace_seconds: float = 5.0):
+        self.lb = lb
+        self.count = max(0, int(count))
+        self.replica_specs = list(replica_specs)
+        self.qos = qos
+        self.trace_dir = trace_dir
+        self.log_dir = log_dir
+        self.start_timeout = float(start_timeout)
+        self.kill_grace_seconds = float(kill_grace_seconds)
+        self.handles: List[_ShardHandle] = []
+        self._procs: List = []
+        self._logs: List = []
+        self._tmp: Optional[str] = None
+
+    def start(self) -> None:
+        import tempfile
+
+        if self.count <= 0:
+            return
+        self._tmp = tempfile.mkdtemp(prefix="glint_fleet_shards_")
+        parent_control = self.lb.control_addr
+        if parent_control is None:
+            raise RuntimeError(
+                "shard fan-out needs the parent balancer built with "
+                "control=True"
+            )
+        pass_fds = ()
+        listen_fd = None
+        if not self.lb._reuse_port:
+            # Fallback shared listener: children adopt the parent's
+            # bound socket by fd (one shared accept queue).
+            listen_fd = self.lb._listener.fileno()
+            pass_fds = (listen_fd,)
+        launched = []
+        for k in range(self.count):
+            shard_id = k + 1
+            port_file = os.path.join(
+                self._tmp, f"shard-{shard_id}.port"
+            )
+            cfg = {
+                "shard": shard_id,
+                "host": self.lb.host,
+                "port": self.lb.port,
+                "reuse_port": self.lb._reuse_port,
+                "listen_fd": listen_fd,
+                "port_file": port_file,
+                "parent_control": list(parent_control),
+                "replicas": self.replica_specs,
+                "qos": self.qos,
+                "proxy_timeout": self.lb.proxy_timeout,
+                "scrape_timeout": self.lb.scrape_timeout,
+                "probe_interval": self.lb.probe_interval,
+                "probe_timeout": self.lb.probe_timeout,
+                "breaker_failures": self.lb.breakers[0].fail_threshold,
+                "breaker_successes":
+                    self.lb.breakers[0].success_threshold,
+                "breaker_open_seconds": self.lb.breakers[0].open_seconds,
+                "trace_log": (
+                    os.path.join(
+                        self.trace_dir,
+                        f"balancer-shard-{shard_id}.jsonl",
+                    )
+                    if self.trace_dir else None
+                ),
+            }
+            cfg_path = os.path.join(
+                self._tmp, f"shard-{shard_id}.json"
+            )
+            # graftlint: ignore[atomic-persist] one-shot handoff file read once by the child
+            with open(cfg_path, "w") as f:
+                json.dump(cfg, f)
+            log = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                # graftlint: ignore[atomic-persist] append-mode process log, not an artifact
+                log = open(
+                    os.path.join(
+                        self.log_dir, f"balancer-shard-{shard_id}.log"
+                    ),
+                    "ab",
+                )
+                self._logs.append(log)
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "glint_word2vec_tpu.cli",
+                    "fleet-shard", "--config", cfg_path,
+                ],
+                pass_fds=pass_fds,
+                stdout=log, stderr=log and subprocess.STDOUT,
+                start_new_session=True,
+            )
+            self._procs.append(proc)
+            launched.append((shard_id, proc, port_file))
+        deadline = time.monotonic() + self.start_timeout
+        for shard_id, proc, port_file in launched:
+            info = None
+            while time.monotonic() < deadline:
+                try:
+                    with open(port_file) as f:
+                        info = json.load(f)
+                    break
+                except (OSError, ValueError):
+                    if proc.poll() is not None:
+                        self.stop_all()
+                        raise RuntimeError(
+                            f"balancer shard {shard_id} exited "
+                            f"rc={proc.returncode} before binding"
+                        )
+                    time.sleep(0.05)
+            if info is None:
+                self.stop_all()
+                raise TimeoutError(
+                    f"balancer shard {shard_id} not ready in "
+                    f"{self.start_timeout:.0f}s"
+                )
+            self.handles.append(_ShardHandle(
+                shard_id, proc,
+                info["control_host"], info["control_port"],
+            ))
+        logger.info(
+            "fleet data plane: %d shard subprocess(es) sharing "
+            "%s:%d (%s)", self.count, self.lb.host, self.lb.port,
+            "SO_REUSEPORT" if self.lb._reuse_port
+            else "inherited listener fd",
+        )
+
+    def broadcast(self, op: dict) -> None:
+        for h in self.handles:
+            h.control(op)
+
+    def snapshots(self) -> List[dict]:
+        return [h.snapshot() for h in self.handles]
+
+    def stop_all(self) -> None:
+        """Fan-out teardown: ask every shard to stop over its control
+        channel, then escalate to terminate/kill — ``serve-fleet``
+        never leaves an orphan balancer process."""
+        for h in self.handles:
+            h.request_stop()
+        deadline = time.monotonic() + self.kill_grace_seconds
+        for proc in self._procs:
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                terminate_process(
+                    proc, grace_seconds=self.kill_grace_seconds
+                )
+        for f in self._logs:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._logs = []
+        if self._tmp:
+            import shutil
+
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
+
+
+class _FleetDataPlane:
+    """The supervisor's single write path to EVERY balancer shard: an
+    op is applied to the in-process balancer first (cheap, lock-free
+    hot path) and mirrored to each shard subprocess over its control
+    channel. Repeated per-sweep assertions (``down``/``fail`` are
+    re-asserted every 0.25s pass) are deduplicated so steady state
+    costs zero control-channel traffic — each shard's own prober
+    keeps its breakers honest between transitions."""
+
+    def __init__(self, lb: LoadBalancer,
+                 shards: Optional[BalancerShardManager] = None):
+        self.lb = lb
+        self.shards = shards
+        self._sent_state: Dict[int, str] = {}
+
+    def _bcast(self, op: dict) -> None:
+        if self.shards is not None:
+            self.shards.broadcast(op)
+
+    def adopt(self, i: int, host: str, port: int,
+              generation: Optional[str]) -> None:
+        self.lb.set_replica_address(i, host, port, generation=generation)
+        self.lb.set_restarting(i, False)
+        self.lb.breakers[i].clear_holds()
+        self.lb.breakers[i].trial()
+        self._sent_state[i] = "up"
+        self._bcast({
+            "op": "set_address", "i": i, "host": host, "port": port,
+            "generation": generation,
+        })
+        self._bcast({"op": "set_restarting", "i": i, "flag": False})
+        self._bcast({"op": "clear_holds", "i": i})
+        self._bcast({"op": "trial", "i": i})
+
+    def down(self, i: int) -> None:
+        """Replica inside a restart window: retry-on-refused + firmly
+        open everywhere."""
+        self.lb.set_restarting(i, True)
+        self.lb.breakers[i].force_open()
+        if self._sent_state.get(i) != "down":
+            self._sent_state[i] = "down"
+            self._bcast({"op": "set_restarting", "i": i, "flag": True})
+            self._bcast({"op": "force_open", "i": i})
+
+    def fail(self, i: int) -> None:
+        """Replica written off (restart budget exhausted): no restart
+        window, breaker firmly open everywhere."""
+        self.lb.set_restarting(i, False)
+        self.lb.breakers[i].force_open()
+        if self._sent_state.get(i) != "failed":
+            self._sent_state[i] = "failed"
+            self._bcast({"op": "set_restarting", "i": i, "flag": False})
+            self._bcast({"op": "force_open", "i": i})
+
+    def hold(self, i: int) -> None:
+        self.lb.breakers[i].hold()
+        self._bcast({"op": "hold", "i": i})
+
+    def release(self, i: int) -> None:
+        self.lb.breakers[i].release()
+        self._bcast({"op": "release", "i": i})
+
+    def clear_holds(self, i: int) -> None:
+        self.lb.breakers[i].clear_holds()
+        self._bcast({"op": "clear_holds", "i": i})
+
+
+# ----------------------------------------------------------------------
+# Warm-spare autoscaler (ISSUE 19)
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class AutoscaleConfig:
+    """Demand-driven capacity policy. Scale-up = RELEASE a warm
+    spare's park hold (the replica is already launched and warmed —
+    readmit, never a cold boot); scale-down = park the highest-index
+    live replica back to spare. Hysteresis windows + cooldown keep the
+    loop from flapping; ``min_live``/``max_live`` bound it."""
+
+    min_live: int
+    max_live: int
+    #: Policy evaluation period (seconds).
+    interval: float = 0.5
+    #: Scale-up pressure: fleet shed rate (sheds/sec across shards,
+    #: QoS sheds included) at or above this...
+    up_shed_per_sec: float = 1.0
+    #: ...or forward-path p95 (ms, max across shards) at or above
+    #: this. None = resolve to the SLO latency threshold
+    #: (GLINT_SLO_LATENCY_MS, 250ms default).
+    up_p95_ms: Optional[float] = None
+    #: Pressure must be SUSTAINED this long before a scale-up...
+    up_window_seconds: float = 1.0
+    #: ...and idle this long before a scale-down (asymmetric on
+    #: purpose: readmitting is cheap and urgent, parking is neither).
+    down_window_seconds: float = 10.0
+    #: Minimum seconds between ANY two transitions.
+    cooldown_seconds: float = 5.0
+
+
+class Autoscaler:
+    """The FleetSupervisor's demand policy loop: reads the signals the
+    fleet already emits (shed rate, forward-path p95 vs the SLO
+    latency target, breaker-open count, fast-burn transitions) and
+    moves replicas between live and parked through the shared
+    :class:`ReplicaHoldLedger` — the same protocol the rollout
+    coordinator holds through, so the two can never fight over a
+    replica. A rollout in progress PINS the replica set (steps are
+    counted, not applied); a replica held by any owner besides the
+    autoscaler — a held canary above all — is never spare capacity.
+
+    Dependency-injected callables keep it unit-testable without a
+    fleet: ``signals()`` returns the current signal doc, ``parked()``
+    the readmittable spares, ``live()`` the parkable live replicas,
+    ``pinned()`` the rollout-pinning flag."""
+
+    def __init__(self, *, holds: ReplicaHoldLedger,
+                 config: AutoscaleConfig,
+                 signals: Callable[[], dict],
+                 parked: Callable[[], List[int]],
+                 live: Callable[[], List[int]],
+                 pinned: Optional[Callable[[], bool]] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        cfg = config
+        if cfg.up_p95_ms is None:
+            cfg.up_p95_ms = float(
+                os.environ.get("GLINT_SLO_LATENCY_MS") or 250.0
+            )
+        self.config = cfg
+        self.holds = holds
+        self._signals = signals
+        self._parked = parked
+        self._live = live
+        self._pinned = pinned or (lambda: False)
+        self._now = now_fn
+        self._mu = threading.Lock()
+        self._last_shed_total: Optional[float] = None
+        self._last_step_t: Optional[float] = None
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_transition_t: Optional[float] = None
+        self._steps = 0
+        self._step_faults = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._pinned_skips = 0
+        self._last_shed_rate = 0.0
+        self._last_p95_ms: Optional[float] = None
+        self._transitions: deque = deque(maxlen=16)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self) -> Optional[str]:
+        """One policy evaluation; returns "up"/"down" when a
+        transition happened, else None."""
+        with self._mu:
+            self._steps += 1
+        try:
+            faults.fire("fleet.autoscale_step")
+        except Exception:
+            with self._mu:
+                self._step_faults += 1
+            return None
+        now = self._now()
+        sig = self._signals() or {}
+        shed_total = float(sig.get("shed_total") or 0.0)
+        p95 = sig.get("p95_ms")
+        with self._mu:
+            if self._last_shed_total is None or self._last_step_t is None:
+                rate = 0.0
+            else:
+                dt = max(now - self._last_step_t, 1e-6)
+                rate = max(0.0, shed_total - self._last_shed_total) / dt
+            self._last_shed_total = shed_total
+            self._last_step_t = now
+            self._last_shed_rate = rate
+            self._last_p95_ms = p95
+        cfg = self.config
+        pressure = (
+            rate >= cfg.up_shed_per_sec
+            or (p95 is not None and p95 >= cfg.up_p95_ms)
+            or int(sig.get("breakers_open") or 0) > 0
+            or bool(sig.get("fast_burn"))
+        )
+        with self._mu:
+            if pressure:
+                self._idle_since = None
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                pressure_for = now - self._pressure_since
+                idle_for = 0.0
+            else:
+                self._pressure_since = None
+                if self._idle_since is None:
+                    self._idle_since = now
+                idle_for = now - self._idle_since
+                pressure_for = 0.0
+            last_t = self._last_transition_t
+        if self._pinned():
+            # Rollout/canary in flight: the replica set is pinned.
+            # Hysteresis clocks keep running — a surge during a
+            # rollout scales up the moment the swap completes.
+            with self._mu:
+                self._pinned_skips += 1
+            return None
+        if last_t is not None and now - last_t < cfg.cooldown_seconds:
+            return None
+        if pressure and pressure_for >= cfg.up_window_seconds:
+            live = self._live()
+            if len(live) >= cfg.max_live:
+                return None
+            spares = [
+                i for i in self.holds.parked("autoscale")
+                if i in set(self._parked())
+            ]
+            if not spares:
+                return None
+            i = spares[0]
+            self.holds.release("autoscale", i)
+            with self._mu:
+                self._scale_ups += 1
+                self._last_transition_t = now
+                self._transitions.append({
+                    "dir": "up", "replica": i,
+                    "shed_rate": round(rate, 3),
+                    "p95_ms": p95,
+                    "t": round(now, 3),
+                })
+            logger.info(
+                "autoscale UP: readmitted warm spare %d "
+                "(shed %.2f/s, p95 %s ms)", i, rate, p95,
+            )
+            return "up"
+        if not pressure and idle_for >= cfg.down_window_seconds:
+            live = self._live()
+            if len(live) <= cfg.min_live:
+                return None
+            candidates = [
+                i for i in live if not self.holds.owners(i)
+            ]
+            if not candidates:
+                return None
+            i = max(candidates)
+            self.holds.acquire("autoscale", i)
+            with self._mu:
+                self._scale_downs += 1
+                self._last_transition_t = now
+                self._idle_since = now
+                self._transitions.append({
+                    "dir": "down", "replica": i,
+                    "shed_rate": round(rate, 3),
+                    "p95_ms": p95,
+                    "t": round(now, 3),
+                })
+            logger.info(
+                "autoscale DOWN: parked replica %d as warm spare "
+                "(idle %.1fs)", i, idle_for,
+            )
+            return "down"
+        return None
+
+    def stats(self) -> dict:
+        cfg = self.config
+        with self._mu:
+            return {
+                "enabled": True,
+                "live": len(self._live()),
+                "spares": len(self.holds.parked("autoscale")),
+                "min_live": cfg.min_live,
+                "max_live": cfg.max_live,
+                "scale_ups_total": self._scale_ups,
+                "scale_downs_total": self._scale_downs,
+                "pinned_skips_total": self._pinned_skips,
+                "steps_total": self._steps,
+                "step_faults_total": self._step_faults,
+                "last_shed_rate": round(self._last_shed_rate, 3),
+                "last_p95_ms": self._last_p95_ms,
+                "transitions": list(self._transitions),
+            }
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="glint-fleet-autoscale",
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval):
+            try:
+                self.step()
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("autoscale step failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _hist_window_delta(prev: Optional[dict],
+                       cur: dict) -> Optional[LatencyHistogram]:
+    """The traffic between two cumulative :class:`LatencyHistogram`
+    states, as a histogram. ``prev`` None (first observation) returns
+    the whole cumulative state; a bucket that went BACKWARDS means the
+    producer restarted and reset, so the current state IS the window.
+    The window's true max is unknowable from cumulative states — the
+    cumulative max only widens the quantile interpolation clamp."""
+    cur_h = LatencyHistogram.from_state(cur)
+    if prev is None:
+        return cur_h
+    prev_h = LatencyHistogram.from_state(prev)
+    out = LatencyHistogram()
+    for i, c in enumerate(cur_h.counts):
+        d = c - prev_h.counts[i]
+        if d < 0:
+            return cur_h
+        out.counts[i] = d
+    out.n = max(0, cur_h.n - prev_h.n)
+    out.total = max(0.0, cur_h.total - prev_h.total)
+    out.max = cur_h.max
+    return out
+
+
+def _sum_balancer_stats(blocks: List[dict]) -> dict:
+    """Fold per-shard ``balancer_stats`` blocks into fleet totals
+    (counters sum; QoS inflight gauges sum, peaks max, per-tenant
+    maps merge key-wise)."""
+    out = {
+        "shed_retries_total": 0,
+        "exhausted_total": 0,
+        "proxied_total": 0,
+        "proxy_errors_total": 0,
+        "breaker_skips_total": 0,
+        "restart_retries_total": 0,
+        "retry_after_honored_total": 0,
+    }
+    qos_out = None
+    for b in blocks:
+        if not b:
+            continue
+        for k in out:
+            out[k] += int(b.get(k) or 0)
+        q = b.get("qos")
+        if q:
+            if qos_out is None:
+                qos_out = {
+                    "admitted_total": {},
+                    "shed_total": {},
+                    "per_tenant_shed_total": {},
+                    "bulk_inflight": 0,
+                    "bulk_inflight_peak": 0,
+                }
+            for key in ("admitted_total", "shed_total",
+                        "per_tenant_shed_total"):
+                for name, n in (q.get(key) or {}).items():
+                    qos_out[key][name] = (
+                        qos_out[key].get(name, 0) + int(n)
+                    )
+            qos_out["bulk_inflight"] += int(q.get("bulk_inflight") or 0)
+            qos_out["bulk_inflight_peak"] = max(
+                qos_out["bulk_inflight_peak"],
+                int(q.get("bulk_inflight_peak") or 0),
+            )
+    if qos_out is not None:
+        out["qos"] = qos_out
+    return out
+
+
+# ----------------------------------------------------------------------
 # Fleet supervisor + launcher
 # ----------------------------------------------------------------------
 
@@ -1859,11 +3239,14 @@ class FleetSupervisor:
     racing it.
     """
 
-    #: ``lb`` and ``coordinator`` are written exactly once (in run(),
-    #: before the supervision loop and any metrics request can touch
-    #: them) and read-only afterwards; lock-free reads see either None
-    #: (ignored) or the final object.
-    _ATOMIC_ATTRS = frozenset({"lb", "coordinator"})
+    #: ``lb``/``coordinator``/``dp``/``holds``/``autoscaler``/``shards``
+    #: are written exactly once (in run(), before the supervision loop
+    #: and any metrics request can touch them) and read-only
+    #: afterwards; lock-free reads see either None (ignored) or the
+    #: final object.
+    _ATOMIC_ATTRS = frozenset({
+        "lb", "coordinator", "dp", "holds", "autoscaler", "shards",
+    })
 
     def __init__(
         self,
@@ -1895,12 +3278,21 @@ class FleetSupervisor:
         coordinated: bool = True,
         build_replica_argv: Optional[Callable[[int, str], List[str]]] = None,
         replica_env_first_launch: Optional[Dict[int, Dict[str, str]]] = None,
+        warm_spares: int = 0,
+        autoscale: Optional[AutoscaleConfig] = None,
+        balancer_procs: int = 1,
+        qos: Optional[QosConfig] = None,
     ):
         if model_dir is None and watch_dir is None \
                 and build_replica_argv is None:
             raise ValueError("model_dir or watch_dir required")
         self.model_dir = model_dir
-        self.num_replicas = max(1, int(replicas))
+        #: ``replicas`` live + ``warm_spares`` launched-and-parked: a
+        #: spare boots, warms, and then sits held out of rotation until
+        #: the autoscaler readmits it (scale-up is never a cold boot).
+        self.base_replicas = max(1, int(replicas))
+        self.warm_spares = max(0, int(warm_spares))
+        self.num_replicas = self.base_replicas + self.warm_spares
         self.host, self.port = host, int(port)
         self.watch_dir = watch_dir
         self.watch_poll = float(watch_poll)
@@ -1931,6 +3323,9 @@ class FleetSupervisor:
         self.coordinated = bool(coordinated)
         self._build_replica_argv = build_replica_argv
         self.replica_env_first_launch = dict(replica_env_first_launch or {})
+        self.balancer_procs = max(1, int(balancer_procs))
+        self.qos = qos
+        self.autoscale_config = autoscale
         self._mu = threading.Lock()
         self._slots = [
             _ReplicaSlot(index=i) for i in range(self.num_replicas)
@@ -1947,6 +3342,14 @@ class FleetSupervisor:
         self.ready = threading.Event()
         self.lb: Optional[LoadBalancer] = None
         self.coordinator: Optional[RolloutCoordinator] = None
+        self.dp: Optional[_FleetDataPlane] = None
+        self.holds: Optional[ReplicaHoldLedger] = None
+        self.autoscaler: Optional[Autoscaler] = None
+        self.shards: Optional[BalancerShardManager] = None
+        #: Previous per-(shard, endpoint) forward-path histogram
+        #: states, diffed by ``_autoscale_signals`` into a windowed
+        #: p95. Touched only by the autoscaler's policy thread.
+        self._autoscale_prev_hists: Dict[Tuple, dict] = {}
 
     # -- replica launch ------------------------------------------------
 
@@ -2059,8 +3462,8 @@ class FleetSupervisor:
                     "%d exhausted — left down, fleet serves from the "
                     "survivors", slot.index, reason, self.max_restarts,
                 )
-                if self.lb is not None:
-                    self.lb.set_restarting(slot.index, False)
+                if self.dp is not None:
+                    self.dp.fail(slot.index)
                 return
             backoff = capped_backoff(
                 slot.restarts, self.backoff_base_seconds,
@@ -2086,17 +3489,15 @@ class FleetSupervisor:
 
     def _adopt(self, slot: _ReplicaSlot, info: dict) -> None:
         """A (re)launched replica published its generation-verified
-        port file: point the balancer at it and half-open its breaker
-        so the prober readmits it after M successes."""
+        port file: point EVERY balancer shard at it and half-open its
+        breaker so each shard's prober readmits it after M successes.
+        Ledger holds survive the relaunch — a parked warm spare that
+        crashes comes back parked, not silently live."""
         slot.host = info.get("host", "127.0.0.1")
         slot.port = int(info["port"])
-        self.lb.set_replica_address(
-            slot.index, slot.host, slot.port,
-            generation=slot.gen_tag(),
-        )
-        self.lb.set_restarting(slot.index, False)
-        self.lb.breakers[slot.index].clear_holds()
-        self.lb.breakers[slot.index].trial()
+        self.dp.adopt(slot.index, slot.host, slot.port, slot.gen_tag())
+        if self.holds is not None:
+            self.holds.reapply(slot.index)
         with self._mu:
             slot.state = "up"
             if slot.detect_t is not None and slot.restart_records:
@@ -2114,18 +3515,17 @@ class FleetSupervisor:
         now = time.monotonic()
         for slot in self._slots:
             if slot.state in ("failed", "stopped"):
-                if slot.state == "failed" and self.lb is not None:
+                if slot.state == "failed" and self.dp is not None:
                     # Keep the breaker firmly open: no trials against
                     # a written-off address.
-                    self.lb.breakers[slot.index].force_open()
+                    self.dp.fail(slot.index)
                 continue
             rc = slot.proc.poll() if slot.proc is not None else None
             if rc is not None and slot.state in ("up", "starting"):
                 if self._stop.is_set():
                     slot.state = "stopped"
                     continue
-                self.lb.set_restarting(slot.index, True)
-                self.lb.breakers[slot.index].force_open()
+                self.dp.down(slot.index)
                 self._schedule_restart(
                     slot,
                     f"exited rc={rc}" if rc >= 0
@@ -2143,8 +3543,7 @@ class FleetSupervisor:
                         "%.1fs) — killing pid %d", slot.index, failing,
                         slot.proc.pid,
                     )
-                    self.lb.set_restarting(slot.index, True)
-                    self.lb.breakers[slot.index].force_open()
+                    self.dp.down(slot.index)
                     terminate_process(
                         slot.proc, grace_seconds=self.kill_grace_seconds
                     )
@@ -2153,14 +3552,12 @@ class FleetSupervisor:
                     )
                 continue
             if slot.state == "backoff":
-                self.lb.set_restarting(slot.index, True)
-                self.lb.breakers[slot.index].force_open()
+                self.dp.down(slot.index)
                 if now >= slot.relaunch_at:
                     self._launch(slot)
                 continue
             if slot.state == "starting":
-                self.lb.set_restarting(slot.index, True)
-                self.lb.breakers[slot.index].force_open()
+                self.dp.down(slot.index)
                 info = self._read_port_file(slot)
                 if info is not None:
                     self._adopt(slot, info)
@@ -2199,6 +3596,36 @@ class FleetSupervisor:
         doc = {"supervisor": sup}
         if self.coordinator is not None:
             doc["rollout"] = self.coordinator.stats()
+        if self.autoscaler is not None:
+            doc["autoscale"] = self.autoscaler.stats()
+        if self.holds is not None:
+            doc["holds"] = self.holds.snapshot()
+        if self.lb is not None:
+            doc["data_plane"] = {
+                "balancer_procs": self.balancer_procs,
+                "reuse_port": self.lb._reuse_port,
+            }
+        if self.shards is not None and self.shards.handles \
+                and self.lb is not None:
+            from glint_word2vec_tpu.obs.aggregate import (
+                merge_serving_snapshots,
+            )
+
+            # Shard 0 is the supervisor's in-process balancer; the
+            # rest are the subprocess shards. Fold their serving
+            # snapshots exactly like replica snapshots (exact
+            # histogram merge, SLO counts summed then re-derived) and
+            # sum the per-shard balancer counters into fleet totals.
+            shard_snaps = (
+                [self.lb.shard_snapshot()] + self.shards.snapshots()
+            )
+            doc["balancer_shards"] = shard_snaps
+            doc["balancer_fleet"] = merge_serving_snapshots([
+                s["serving"] for s in shard_snaps if s.get("serving")
+            ])
+            doc["balancer"] = _sum_balancer_stats([
+                s.get("stats") for s in shard_snaps if s.get("up")
+            ])
         return doc
 
     def report(self) -> dict:
@@ -2293,6 +3720,7 @@ class FleetSupervisor:
                 urls = [
                     f"http://{s.host}:{s.port}" for s in self._slots
                 ]
+                multi = self.balancer_procs > 1
                 self.lb = LoadBalancer(
                     urls, host=self.host, port=self.port,
                     breaker_failures=self.breaker_failures,
@@ -2300,27 +3728,62 @@ class FleetSupervisor:
                     breaker_open_seconds=self.breaker_open_seconds,
                     probe_interval=self.probe_interval,
                     probe_timeout=self.probe_timeout,
+                    reuse_port=multi,
+                    control=multi,
+                    shard_id=0,
+                    qos=self.qos,
                 )
                 for slot in self._slots:
                     self.lb.set_replica_address(
                         slot.index, slot.host, slot.port,
                         generation=slot.gen_tag(),
                     )
+                self.dp = _FleetDataPlane(self.lb)
+                self.holds = ReplicaHoldLedger(
+                    self.dp.hold, self.dp.release, self.dp.clear_holds,
+                )
+                # Park the warm spares BEFORE any traffic flows: they
+                # are launched and fully warmed but held out of
+                # rotation until the autoscaler readmits them.
+                for slot in self._slots[self.base_replicas:]:
+                    self.holds.acquire("autoscale", slot.index)
                 self.lb.doc_extra = self._doc_extra
                 self.lb.on_shutdown = self._stop.set
                 if self.trace_dir:
                     self.lb.enable_flight_recorder(
                         os.path.join(self.trace_dir, "flight")
                     )
-                if self.port_file:
-                    from glint_word2vec_tpu.utils import atomic_write_json
-
-                    atomic_write_json(
-                        self.port_file,
-                        {"host": self.lb.host, "port": self.lb.port},
-                    )
                 self.lb.start_background()
                 self.lb.start_prober()
+                if multi:
+                    qos_dict = None
+                    if self.qos is not None:
+                        qos_dict = {
+                            "tenant_rate": self.qos.tenant_rate,
+                            "tenant_burst": self.qos.tenant_burst,
+                            "bulk_max_inflight":
+                                self.qos.bulk_max_inflight,
+                            "max_tenants": self.qos.max_tenants,
+                        }
+                    self.shards = BalancerShardManager(
+                        self.lb, self.balancer_procs - 1,
+                        replica_specs=[
+                            {
+                                "host": s.host, "port": s.port,
+                                "generation": s.gen_tag(),
+                                "held": bool(
+                                    self.holds.owners(s.index)
+                                ),
+                            }
+                            for s in self._slots
+                        ],
+                        qos=qos_dict,
+                        trace_dir=self.trace_dir,
+                        log_dir=self.log_dir,
+                        kill_grace_seconds=self.kill_grace_seconds,
+                    )
+                    self.shards.start()
+                    self.dp.shards = self.shards
                 if self.coordinated and self.watch_dir is not None:
                     with self._mu:
                         cur_dir = self._current_model_dir
@@ -2333,12 +3796,45 @@ class FleetSupervisor:
                         step_timeout=self.rollout_step_timeout,
                         replica_ok=self._replica_ok,
                         on_generation=self._on_generation,
+                        holds=self.holds,
                     )
                     self.coordinator.start()
+                if self.warm_spares > 0 \
+                        or self.autoscale_config is not None:
+                    cfg = self.autoscale_config or AutoscaleConfig(
+                        min_live=self.base_replicas,
+                        max_live=self.num_replicas,
+                    )
+                    pinned = (
+                        self.coordinator.in_progress
+                        if self.coordinator is not None else None
+                    )
+                    self.autoscaler = Autoscaler(
+                        holds=self.holds, config=cfg,
+                        signals=self._autoscale_signals,
+                        parked=self._autoscale_parked,
+                        live=self._autoscale_live,
+                        pinned=pinned,
+                    )
+                    self.autoscaler.start()
+                # The port file is the readiness signal: written only
+                # once the WHOLE control plane (balancer shards,
+                # rollout coordinator, autoscaler) is assembled, so
+                # the first /metrics a reader sends after seeing it
+                # already carries every doc section.
+                if self.port_file:
+                    from glint_word2vec_tpu.utils import atomic_write_json
+
+                    atomic_write_json(
+                        self.port_file,
+                        {"host": self.lb.host, "port": self.lb.port},
+                    )
                 logger.info(
-                    "fleet up: %d replicas (%s) behind %s:%d%s",
+                    "fleet up: %d replicas (%s, %d warm spare(s)) "
+                    "behind %s:%d x%d balancer proc(s)%s",
                     self.num_replicas, ", ".join(urls),
-                    self.lb.host, self.lb.port,
+                    self.warm_spares,
+                    self.lb.host, self.lb.port, self.balancer_procs,
                     f", serving {boot_gen}" if boot_gen else "",
                 )
                 self.ready.set()
@@ -2353,8 +3849,12 @@ class FleetSupervisor:
             finally:
                 self._stop.set()
                 self.ready.set()
+                if self.autoscaler is not None:
+                    self.autoscaler.stop()
                 if self.coordinator is not None:
                     self.coordinator.stop()
+                if self.shards is not None:
+                    self.shards.stop_all()
                 if self.lb is not None:
                     self.lb.stop()
                 for slot in self._slots:
@@ -2374,6 +3874,100 @@ class FleetSupervisor:
     def _replica_ok(self, i: int) -> bool:
         with self._mu:
             return self._slots[i].state not in ("failed", "stopped")
+
+    # -- autoscaler plumbing -------------------------------------------
+
+    def _autoscale_signals(self) -> dict:
+        """The demand signals the fleet already emits, folded across
+        every balancer shard: cumulative shed count (retry-path sheds +
+        exhaustions + QoS sheds), WINDOWED forward-path p95 (over the
+        traffic since the previous policy step — a cumulative p95
+        would never decay after one surge, so idle could never be
+        detected and scale-down would never fire), breaker-open count,
+        and any SLO fast-burn alert."""
+        lb = self.lb
+        if lb is None:
+            return {}
+        blocks = [lb.balancer_stats()]
+        snaps = [lb.shard_snapshot()]
+        if self.shards is not None:
+            for s in self.shards.snapshots():
+                snaps.append(s)
+                if s.get("up") and s.get("stats"):
+                    blocks.append(s["stats"])
+        shed = 0.0
+        for b in blocks:
+            shed += int(b.get("shed_retries_total") or 0)
+            shed += int(b.get("exhausted_total") or 0)
+            q = b.get("qos")
+            if q:
+                shed += sum((q.get("shed_total") or {}).values())
+        fast_burn = False
+        deltas = []
+        cur: Dict[Tuple, dict] = {}
+        for s in snaps:
+            serving = s.get("serving") or {}
+            for path, ep in (serving.get("endpoints") or {}).items():
+                hs = ep.get("hist")
+                if not hs:
+                    continue
+                key = (s.get("shard"), path)
+                cur[key] = hs
+                d = _hist_window_delta(
+                    self._autoscale_prev_hists.get(key), hs
+                )
+                if d is not None:
+                    deltas.append(d)
+            slo = serving.get("slo") or {}
+            for ep in (slo.get("endpoints") or {}).values():
+                if (ep.get("alerts") or {}).get("fast_burn"):
+                    fast_burn = True
+        self._autoscale_prev_hists = cur
+        p95 = None
+        if deltas:
+            h = LatencyHistogram.merge(deltas)
+            if h.n > 0:
+                p95 = round(h.quantile(0.95) * 1e3, 3)
+        breakers_open = sum(
+            1 for b in lb.breakers
+            if b.state() == ReplicaBreaker.OPEN
+        )
+        return {
+            "shed_total": shed,
+            "p95_ms": p95,
+            "breakers_open": breakers_open,
+            "fast_burn": fast_burn,
+        }
+
+    def _autoscale_live(self) -> List[int]:
+        """Replicas currently serving traffic: up, and held by no
+        owner (a parked spare or a mid-rollout replica is not live)."""
+        out = []
+        for s in self._slots:
+            with self._mu:
+                up = s.state == "up"
+            if up and not self.holds.owners(s.index):
+                out.append(s.index)
+        return out
+
+    def _autoscale_parked(self) -> List[int]:
+        """Warm spares the autoscaler may readmit: up, breaker CLOSED
+        (the prober vouches for them), and held by the autoscaler
+        ALONE — a canary or rollout hold disqualifies a replica from
+        being spare capacity."""
+        out = []
+        for s in self._slots:
+            with self._mu:
+                up = s.state == "up"
+            if not up:
+                continue
+            if self.holds.owners(s.index) != frozenset(("autoscale",)):
+                continue
+            if self.lb.breakers[s.index].state() \
+                    != ReplicaBreaker.CLOSED:
+                continue
+            out.append(s.index)
+        return out
 
     def _on_generation(self, gen: str, gen_dir: str) -> None:
         """Rollout coordinator promoted ``gen`` fleet-wide: relaunches

@@ -1110,6 +1110,115 @@ def fleet_to_prometheus(doc: dict) -> str:
                  index.get("recall_at10"))
         p.sample("glint_fleet_index_recall_gate_ok", label,
                  1 if index.get("recall_gate_ok") else 0)
+    # Multi-process data plane (ISSUE 19): per-shard balancer blocks
+    # (shard 0 = the supervisor's in-process balancer) + the retry
+    # and QoS admission counters summed across shards in "balancer".
+    p.head("glint_fleet_retry_after_honored_total", "counter",
+           "All-replicas-shed retries that backed off by the replicas' "
+           "own Retry-After hint before the next forward round.")
+    p.sample("glint_fleet_retry_after_honored_total", None,
+             bal.get("retry_after_honored_total", 0))
+    dplane = doc.get("data_plane") or {}
+    p.head("glint_fleet_balancer_procs", "gauge",
+           "Balancer processes sharing the fleet listen port "
+           "(SO_REUSEPORT or an inherited listener fd).")
+    p.sample("glint_fleet_balancer_procs", None,
+             dplane.get("balancer_procs", 1))
+    shards = doc.get("balancer_shards") or []
+    p.head("glint_fleet_shard_up", "gauge",
+           "Whether the balancer shard answered the last control-"
+           "channel snapshot.")
+    for s in shards:
+        p.sample("glint_fleet_shard_up",
+                 {"shard": str(s.get("shard", ""))},
+                 1 if s.get("up") else 0)
+    for name, key, help_ in [
+        ("glint_fleet_shard_proxied_total", "proxied_total",
+         "Requests this balancer shard forwarded."),
+        ("glint_fleet_shard_shed_retries_total", "shed_retries_total",
+         "Shed-driven replica retries on this balancer shard."),
+        ("glint_fleet_shard_exhausted_total", "exhausted_total",
+         "Requests this shard relayed as all-replicas-shed."),
+    ]:
+        p.head(name, "counter", help_)
+        for s in shards:
+            stats = s.get("stats") or {}
+            p.sample(name, {"shard": str(s.get("shard", ""))},
+                     stats.get(key, 0))
+    p.head("glint_fleet_shard_requests_total", "counter",
+           "Device-path requests observed by the shard's forward path, "
+           "by endpoint.")
+    p.head("glint_fleet_shard_request_p95_ms", "gauge",
+           "Forward-path p95 latency of the shard, by endpoint "
+           "(client-observed: queueing + replica round trip).")
+    for s in shards:
+        serving = s.get("serving") or {}
+        for ep, es in sorted((serving.get("endpoints") or {}).items()):
+            lbl = {"shard": str(s.get("shard", "")), "endpoint": ep}
+            p.sample("glint_fleet_shard_requests_total", lbl,
+                     es.get("count", 0))
+            p.sample("glint_fleet_shard_request_p95_ms", lbl,
+                     es.get("p95_ms"))
+    # Warm-spare autoscaler (ISSUE 19).
+    auto = doc.get("autoscale") or {}
+    if auto:
+        p.head("glint_fleet_autoscale_live", "gauge",
+               "Replicas currently live (serving traffic, no holds).")
+        p.sample("glint_fleet_autoscale_live", None, auto.get("live", 0))
+        p.head("glint_fleet_autoscale_spares", "gauge",
+               "Warm spares parked by the autoscaler (launched and "
+               "warmed, held out of rotation).")
+        p.sample("glint_fleet_autoscale_spares", None,
+                 auto.get("spares", 0))
+        for name, key, help_ in [
+            ("glint_fleet_autoscale_ups_total", "scale_ups_total",
+             "Scale-up transitions (warm-spare readmits)."),
+            ("glint_fleet_autoscale_downs_total", "scale_downs_total",
+             "Scale-down transitions (live replicas parked to spare)."),
+            ("glint_fleet_autoscale_pinned_skips_total",
+             "pinned_skips_total",
+             "Policy steps skipped because a rollout/canary pinned "
+             "the replica set."),
+            ("glint_fleet_autoscale_steps_total", "steps_total",
+             "Autoscaler policy evaluations."),
+        ]:
+            p.head(name, "counter", help_)
+            p.sample(name, None, auto.get(key, 0))
+        p.head("glint_fleet_autoscale_last_shed_rate", "gauge",
+               "Fleet shed rate (sheds/sec, QoS sheds included) at the "
+               "last policy step.")
+        p.sample("glint_fleet_autoscale_last_shed_rate", None,
+                 auto.get("last_shed_rate", 0))
+    # QoS admission (ISSUE 19): per-tenant quota + class shed
+    # accounting from the balancer's front door.
+    qos = bal.get("qos") or {}
+    if qos:
+        p.head("glint_fleet_qos_admitted_total", "counter",
+               "Requests admitted by the QoS gate, by priority class.")
+        for cls, n in sorted((qos.get("admitted_total") or {}).items()):
+            p.sample("glint_fleet_qos_admitted_total",
+                     {"class": cls}, n)
+        p.head("glint_fleet_qos_shed_total", "counter",
+               "Requests shed by the QoS gate, by reason (tenant "
+               "quota, bulk-class inflight cap, infeasible deadline).")
+        for reason, n in sorted((qos.get("shed_total") or {}).items()):
+            p.sample("glint_fleet_qos_shed_total",
+                     {"reason": reason}, n)
+        p.head("glint_fleet_qos_tenant_shed_total", "counter",
+               "Requests shed by the QoS gate, by tenant "
+               "(X-Glint-Tenant).")
+        for tenant, n in sorted(
+                (qos.get("per_tenant_shed_total") or {}).items()):
+            p.sample("glint_fleet_qos_tenant_shed_total",
+                     {"tenant": tenant}, n)
+        p.head("glint_fleet_qos_bulk_inflight", "gauge",
+               "Bulk-class requests currently in flight.")
+        p.sample("glint_fleet_qos_bulk_inflight", None,
+                 qos.get("bulk_inflight", 0))
+        p.head("glint_fleet_qos_bulk_inflight_peak", "gauge",
+               "Peak concurrent bulk-class requests since boot.")
+        p.sample("glint_fleet_qos_bulk_inflight_peak", None,
+                 qos.get("bulk_inflight_peak", 0))
     return p.text()
 
 

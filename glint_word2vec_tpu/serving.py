@@ -1153,6 +1153,22 @@ class ModelServer:
                     time.monotonic() + server.request_deadline
                     if server.request_deadline else None
                 )
+                # Deadline propagation (ISSUE 19): a balancer forwards
+                # the client's REMAINING budget as X-Glint-Deadline-Ms;
+                # it can only tighten the replica's own deadline, never
+                # extend it.
+                hdr = self.headers.get("X-Glint-Deadline-Ms")
+                if hdr is not None:
+                    try:
+                        budget = max(0.0, float(hdr)) / 1e3
+                    except (TypeError, ValueError):
+                        budget = None
+                    if budget is not None:
+                        remote = time.monotonic() + budget
+                        deadline = (
+                            remote if deadline is None
+                            else min(deadline, remote)
+                        )
                 try:
                     if path == "/synonyms":
                         out = [

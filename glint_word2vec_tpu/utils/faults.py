@@ -89,6 +89,10 @@ POINTS = {
     "transform.shard_commit":
         "after each bulk-transform vector shard + sidecar manifest "
         "commit",
+    "fleet.shard_accept":
+        "once per accepted connection on a balancer data-plane shard",
+    "fleet.autoscale_step":
+        "before each warm-spare autoscaler policy evaluation",
 }
 
 _ACTIONS = ("exc", "kill", "hang", "delay")

@@ -1,0 +1,669 @@
+"""Demand-driven fleet tests (ISSUE 19): multi-process balancer data
+plane (shard subprocesses sharing one listen port, fan-out teardown),
+shard-snapshot merging through ``merge_serving_snapshots``, the
+replica-hold ownership ledger shared by rollout and autoscaler, the
+warm-spare autoscaler policy loop, QoS admission (tenant quotas, bulk
+class cap, deadline-aware shedding), and the Retry-After-honoring
+balancer retry path."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from glint_word2vec_tpu.fleet import (
+    AutoscaleConfig,
+    Autoscaler,
+    BalancerShardManager,
+    LoadBalancer,
+    QosConfig,
+    QosGate,
+    ReplicaHoldLedger,
+    _BalancerMetrics,
+    _sum_balancer_stats,
+)
+from glint_word2vec_tpu.obs.aggregate import merge_serving_snapshots
+from glint_word2vec_tpu.obs.prometheus import (
+    fleet_to_prometheus,
+    lint_prometheus_text,
+)
+from glint_word2vec_tpu.utils.metrics import LatencyHistogram
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class _EchoReplica:
+    """Jax-free replica stub: 200-answers /healthz and every POST, with
+    an optional shed-first-N switch carrying Retry-After."""
+
+    def __init__(self, shed_first=0, retry_after="0.05"):
+        self.requests = 0
+        self.shed_first = shed_first
+        self._mu = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, extra=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/metrics":
+                    return self._send(200, {"endpoints": {}})
+                self._send(404, {"error": "no route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                with stub._mu:
+                    stub.requests += 1
+                    shed = stub.requests <= stub.shed_first
+                if shed:
+                    return self._send(
+                        429, {"error": "stub shedding"},
+                        extra={"Retry-After": retry_after},
+                    )
+                if self.path == "/shutdown":
+                    return self._send(200, {"status": "bye"})
+                return self._send(
+                    200, [[req.get("word", "?"), 0.9]]
+                )
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _post(host, port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(host, port, path):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=30
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+# ----------------------------------------------------------------------
+# Replica-hold ownership ledger
+# ----------------------------------------------------------------------
+
+
+def test_hold_ledger_refcounts_by_owner():
+    calls = []
+    led = ReplicaHoldLedger(
+        lambda i: calls.append(("hold", i)),
+        lambda i: calls.append(("release", i)),
+        lambda i: calls.append(("clear", i)),
+    )
+    assert led.acquire("autoscale", 1)
+    assert not led.acquire("autoscale", 1)  # no double-acquire
+    assert led.owners(1) == frozenset({"autoscale"})
+    assert led.parked("autoscale") == [1]
+    # A second owner (rollout draining the replica) disqualifies it
+    # from being autoscaler spare capacity.
+    assert led.acquire("rollout", 1)
+    assert led.parked("autoscale") == []
+    assert led.release("rollout", 1)
+    assert led.parked("autoscale") == [1]
+    # Releasing a hold you don't own is a no-op, not an underflow.
+    assert not led.release("rollout", 1)
+    assert led.owners(1) == frozenset({"autoscale"})
+    assert calls.count(("hold", 1)) == 2
+    assert calls.count(("release", 1)) == 1
+
+
+def test_hold_ledger_reapply_after_relaunch():
+    """A parked spare that crashes and relaunches must come back
+    parked: reapply() re-asserts one breaker hold per surviving
+    owner after the relaunch cleared them."""
+    holds = []
+    led = ReplicaHoldLedger(
+        lambda i: holds.append(i), lambda i: None,
+        lambda i: holds.clear(),
+    )
+    led.acquire("autoscale", 0)
+    holds.clear()  # the relaunch path cleared breaker holds
+    led.reapply(0)
+    assert holds == [0]
+    assert led.owners(0) == frozenset({"autoscale"})
+    assert led.snapshot() == {"held": {"0": ["autoscale"]}}
+
+
+# ----------------------------------------------------------------------
+# Warm-spare autoscaler policy loop
+# ----------------------------------------------------------------------
+
+
+def _mk_autoscaler(led, sig, live, *, pinned=None, now, **cfg_kw):
+    cfg = AutoscaleConfig(
+        min_live=cfg_kw.pop("min_live", 2),
+        max_live=cfg_kw.pop("max_live", 3),
+        up_shed_per_sec=1.0, up_window_seconds=1.0,
+        down_window_seconds=5.0, cooldown_seconds=2.0, **cfg_kw,
+    )
+    return Autoscaler(
+        holds=led, config=cfg, signals=lambda: dict(sig),
+        parked=lambda: led.parked("autoscale"),
+        live=lambda: list(live), pinned=pinned,
+        now_fn=lambda: now[0],
+    )
+
+
+def test_autoscaler_readmits_then_parks():
+    led = ReplicaHoldLedger(lambda i: None, lambda i: None)
+    led.acquire("autoscale", 2)
+    live = [0, 1]
+    now = [0.0]
+    sig = {"shed_total": 0.0, "p95_ms": 10.0,
+           "breakers_open": 0, "fast_burn": False}
+    a = _mk_autoscaler(led, sig, live, now=now)
+    assert a.step() is None  # first step only primes the rate window
+    sig["shed_total"] = 100.0
+    now[0] = 1.0
+    assert a.step() is None  # pressure must SUSTAIN the up-window
+    sig["shed_total"] = 300.0
+    now[0] = 2.5
+    assert a.step() == "up"
+    assert led.parked("autoscale") == []  # spare readmitted
+    live.append(2)
+    # Sustained idle parks the highest-index live replica back.
+    sig["shed_total"] = 300.0  # rate goes to zero from here on
+    out = []
+    for t in (3.0, 4.0, 6.0, 9.0):
+        now[0] = t
+        out.append(a.step())
+    assert out[-1] == "down"
+    assert led.parked("autoscale") == [2]
+    st = a.stats()
+    assert st["scale_ups_total"] == 1
+    assert st["scale_downs_total"] == 1
+    assert st["steps_total"] == 7
+    assert [tr["dir"] for tr in st["transitions"]] == ["up", "down"]
+
+
+def test_autoscaler_pinned_by_rollout_never_transitions():
+    led = ReplicaHoldLedger(lambda i: None, lambda i: None)
+    led.acquire("autoscale", 2)
+    now = [0.0]
+    sig = {"shed_total": 0.0, "p95_ms": 10_000.0,
+           "breakers_open": 3, "fast_burn": True}
+    a = _mk_autoscaler(led, sig, [0, 1], pinned=lambda: True, now=now)
+    for t in (0.0, 1.0, 2.0, 3.0, 4.0):
+        now[0] = t
+        assert a.step() is None
+    st = a.stats()
+    assert st["pinned_skips_total"] == 5
+    assert st["scale_ups_total"] == 0
+    assert led.parked("autoscale") == [2]  # the spare stayed parked
+
+
+def test_autoscaler_held_canary_is_not_spare_capacity():
+    led = ReplicaHoldLedger(lambda i: None, lambda i: None)
+    led.acquire("autoscale", 2)
+    led.acquire("rollout", 2)  # the spare is ALSO the staged canary
+    now = [0.0]
+    sig = {"shed_total": 0.0, "p95_ms": 10_000.0,
+           "breakers_open": 0, "fast_burn": False}
+    a = _mk_autoscaler(led, sig, [0, 1], now=now)
+    for t in (0.0, 1.5, 3.0):
+        now[0] = t
+        assert a.step() is None  # pressure, but no eligible spare
+    assert a.stats()["scale_ups_total"] == 0
+
+
+def test_autoscaler_respects_min_max_bounds():
+    led = ReplicaHoldLedger(lambda i: None, lambda i: None)
+    now = [0.0]
+    sig = {"shed_total": 0.0, "p95_ms": 10_000.0,
+           "breakers_open": 0, "fast_burn": False}
+    # Already at max_live with no spares: pressure cannot scale up.
+    a = _mk_autoscaler(led, sig, [0, 1, 2], max_live=3, now=now)
+    for t in (0.0, 1.5, 3.0):
+        now[0] = t
+        assert a.step() is None
+    # At min_live: idle cannot scale down.
+    calm = {"shed_total": 0.0, "p95_ms": 1.0,
+            "breakers_open": 0, "fast_burn": False}
+    b = _mk_autoscaler(led, calm, [0, 1], min_live=2, now=now)
+    for t in (10.0, 13.0, 17.0, 22.0):
+        now[0] = t
+        assert b.step() is None
+    assert led.parked("autoscale") == []
+
+
+# ----------------------------------------------------------------------
+# QoS admission gate
+# ----------------------------------------------------------------------
+
+
+def test_qos_tenant_token_bucket():
+    now = [0.0]
+    g = QosGate(QosConfig(tenant_rate=1.0, tenant_burst=2.0),
+                lambda p: None, now_fn=lambda: now[0])
+    hdr_a = {"x-glint-tenant": "job-a"}
+    hdr_b = {"x-glint-tenant": "job-b"}
+    assert g.admit("/synonyms", hdr_a).shed is None
+    assert g.admit("/synonyms", hdr_a).shed is None
+    d = g.admit("/synonyms", hdr_a)  # burst of 2 exhausted
+    assert d.shed is not None and d.shed[0] == 429
+    assert d.shed[1]["error"] == "tenant quota exceeded"
+    # Tenant isolation: job-b's bucket is untouched by job-a's flood.
+    assert g.admit("/synonyms", hdr_b).shed is None
+    # Refill: one token per second.
+    now[0] = 1.1
+    assert g.admit("/synonyms", hdr_a).shed is None
+    snap = g.snapshot()
+    assert snap["per_tenant_shed_total"] == {"job-a": 1}
+    assert snap["shed_total"]["tenant_quota"] == 1
+
+
+def test_qos_bulk_class_inflight_cap():
+    g = QosGate(QosConfig(bulk_max_inflight=1), lambda p: None,
+                now_fn=lambda: 0.0)
+    bulk = {"x-glint-priority": "bulk", "x-glint-tenant": "bulk-job"}
+    d1 = g.admit("/synonyms", bulk)
+    assert d1.shed is None and d1.bulk_slot
+    d2 = g.admit("/synonyms", bulk)
+    assert d2.shed is not None and d2.shed[0] == 429
+    # Interactive traffic is never gated by the bulk cap.
+    assert g.admit("/synonyms", {}).shed is None
+    g.release(d1)
+    d3 = g.admit("/synonyms", bulk)
+    assert d3.shed is None
+    snap = g.snapshot()
+    assert snap["shed_total"]["bulk_inflight"] == 1
+    assert snap["admitted_total"] == {"interactive": 1, "bulk": 2}
+    assert snap["bulk_inflight_peak"] == 1
+
+
+def test_qos_deadline_infeasible_shed():
+    """A request whose remaining deadline cannot cover the current p95
+    is shed IMMEDIATELY with Retry-After — it never occupies a slot it
+    would only time out in."""
+    g = QosGate(QosConfig(), lambda p: 80.0, now_fn=lambda: 0.0)
+    d = g.admit("/synonyms", {"x-glint-deadline-ms": "20"})
+    assert d.shed is not None
+    status, obj, retry_after = d.shed
+    assert status == 429
+    assert obj["error"] == "deadline infeasible"
+    assert obj["p95_ms"] == 80.0
+    assert float(retry_after) > 0
+    # A feasible deadline passes.
+    assert g.admit("/synonyms", {"x-glint-deadline-ms": "500"}).shed \
+        is None
+    # Unknown p95 (no traffic yet): only a non-positive budget sheds.
+    g2 = QosGate(QosConfig(), lambda p: None, now_fn=lambda: 0.0)
+    assert g2.admit("/synonyms", {"x-glint-deadline-ms": "5"}).shed \
+        is None
+    assert g2.admit("/synonyms", {"x-glint-deadline-ms": "0"}).shed \
+        is not None
+    assert g.snapshot()["shed_total"]["deadline"] == 1
+
+
+def test_qos_admission_end_to_end_per_tenant_accounting():
+    """Through the real balancer: the flooding bulk tenant is the one
+    shed (per-tenant accounting proves it), interactive default-bucket
+    traffic is untouched, and the QoS block renders lint-clean."""
+    rep = _EchoReplica()
+    lb = LoadBalancer(
+        [rep.url], port=0,
+        qos=QosConfig(tenant_rate=2.0, tenant_burst=2.0),
+    )
+    lb.start_background()
+    try:
+        bulk_hdr = {"X-Glint-Tenant": "bulk-job",
+                    "X-Glint-Priority": "bulk"}
+        codes = [
+            _post(lb.host, lb.port, "/synonyms", {"word": "w"},
+                  headers=bulk_hdr)[0]
+            for _ in range(6)
+        ]
+        assert codes.count(429) == 4  # burst 2, then the quota sheds
+        for _ in range(2):
+            code, _, _ = _post(lb.host, lb.port, "/synonyms",
+                               {"word": "w"})
+            assert code == 200
+        _, doc = _get(lb.host, lb.port, "/metrics")
+        qos = doc["balancer"]["qos"]
+        assert qos["per_tenant_shed_total"] == {"bulk-job": 4}
+        assert qos["shed_total"]["tenant_quota"] == 4
+        assert qos["admitted_total"]["interactive"] == 2
+        text = fleet_to_prometheus(doc)
+        lint_prometheus_text(text)
+        assert 'glint_fleet_qos_tenant_shed_total{tenant="bulk-job"} 4' \
+            in text
+    finally:
+        lb.stop()
+        rep.stop()
+
+
+def test_deadline_header_sheds_before_forward():
+    """X-Glint-Deadline-Ms: 0 must be shed BY THE BALANCER (429 +
+    Retry-After), never forwarded to occupy a replica slot."""
+    rep = _EchoReplica()
+    lb = LoadBalancer([rep.url], port=0, qos=QosConfig())
+    lb.start_background()
+    try:
+        code, headers, obj = _post(
+            lb.host, lb.port, "/synonyms", {"word": "w"},
+            headers={"X-Glint-Deadline-Ms": "0"},
+        )
+        assert code == 429
+        assert obj["error"] == "deadline infeasible"
+        assert "Retry-After" in headers
+        assert rep.requests == 0  # never reached the replica
+        _, doc = _get(lb.host, lb.port, "/metrics")
+        assert doc["balancer"]["qos"]["shed_total"]["deadline"] == 1
+    finally:
+        lb.stop()
+        rep.stop()
+
+
+# ----------------------------------------------------------------------
+# Retry-After-honoring retry path
+# ----------------------------------------------------------------------
+
+
+def test_retry_after_honored_when_all_replicas_shed():
+    """All replicas shed with a SMALL Retry-After: the balancer backs
+    off by the replica's own hint and the retry round succeeds —
+    counted on retry_after_honored_total."""
+    rep = _EchoReplica(shed_first=1, retry_after="0.05")
+    lb = LoadBalancer([rep.url], port=0)
+    lb.start_background()
+    try:
+        t0 = time.monotonic()
+        code, _, out = _post(lb.host, lb.port, "/synonyms",
+                             {"word": "w"})
+        took = time.monotonic() - t0
+        assert code == 200 and out == [["w", 0.9]]
+        assert took >= 0.05  # actually backed off
+        _, doc = _get(lb.host, lb.port, "/metrics")
+        assert doc["balancer"]["retry_after_honored_total"] == 1
+        assert doc["balancer"]["exhausted_total"] == 0
+    finally:
+        lb.stop()
+        rep.stop()
+
+
+def test_large_retry_after_still_relays_immediately():
+    """A Retry-After beyond the balancer's cap is the CLIENT's backoff
+    to pay: relay the shed without sleeping on it (the existing
+    test_all_shed_relays_backpressure contract, restated against the
+    honor path)."""
+    rep = _EchoReplica(shed_first=1000, retry_after="7")
+    lb = LoadBalancer([rep.url], port=0)
+    lb.start_background()
+    try:
+        t0 = time.monotonic()
+        code, headers, _ = _post(lb.host, lb.port, "/synonyms",
+                                 {"word": "w"})
+        took = time.monotonic() - t0
+        assert code == 429
+        assert headers.get("Retry-After") == "7"
+        assert took < 5.0
+        _, doc = _get(lb.host, lb.port, "/metrics")
+        assert doc["balancer"]["retry_after_honored_total"] == 0
+        assert doc["balancer"]["exhausted_total"] == 1
+    finally:
+        lb.stop()
+        rep.stop()
+
+
+# ----------------------------------------------------------------------
+# Shard snapshots fold through merge_serving_snapshots
+# ----------------------------------------------------------------------
+
+
+def _observed_metrics(samples):
+    m = _BalancerMetrics()
+    for path, seconds, status in samples:
+        m.observe(path, seconds, status)
+    return m
+
+
+def test_merge_shard_snapshots_exact():
+    """Fleet totals = per-shard sums, the histogram merge is bit-equal
+    to the whole-population truth, and SLO window counts sum before
+    burn re-derivation — shard snapshots merge EXACTLY like replica
+    snapshots."""
+    shard_a = [("/synonyms", 0.010 * (i + 1), 200) for i in range(40)]
+    shard_b = [("/synonyms", 0.005 * (i + 1), 200) for i in range(60)]
+    shard_b += [("/synonyms", 0.5, 503) for _ in range(5)]
+    snap_a = _observed_metrics(shard_a).snapshot()
+    snap_b = _observed_metrics(shard_b).snapshot()
+    merged = merge_serving_snapshots([snap_a, snap_b])
+    ep = merged["endpoints"]["/synonyms"]
+    assert ep["count"] == 105
+    assert ep["errors"] == 5
+    assert "approx" not in ep
+    # Bit-equal histogram truth: one histogram fed the whole
+    # population must state-match the merge of the per-shard ones.
+    truth = LatencyHistogram()
+    for _, seconds, _ in shard_a + shard_b:
+        truth.record(seconds)
+    truth_state = truth.state()
+    merged_state = dict(ep["hist"])
+    # Bucket counts, n, max are integer/exact; the float `total` sums
+    # in a different order across shards (associativity, not data).
+    assert merged_state.pop("total") == pytest.approx(
+        truth_state.pop("total"), rel=1e-12
+    )
+    assert merged_state == truth_state
+    assert ep["p95_ms"] == round(truth.quantile(0.95) * 1e3, 3)
+    # SLO window counts summed before burns re-derive.
+    slo = merged["slo"]["endpoints"]["/synonyms"]
+    assert slo["windows"]["5m"]["total"] == 105
+    assert slo["windows"]["5m"]["bad_availability"] == 5
+    assert set(slo["alerts"]) == {"fast_burn", "slow_burn"}
+
+
+def test_shard_labeled_exposition_lints():
+    snap = _observed_metrics(
+        [("/synonyms", 0.02, 200)] * 8 + [("/analogy", 0.1, 500)]
+    ).snapshot()
+    shard0 = {"shard": 0, "up": True, "serving": snap,
+              "stats": {"proxied_total": 9, "shed_retries_total": 1,
+                        "exhausted_total": 0, "proxy_errors_total": 0,
+                        "breaker_skips_total": 0,
+                        "restart_retries_total": 0,
+                        "retry_after_honored_total": 0}}
+    shard1 = {"shard": 1, "up": False, "error": "connection refused"}
+    doc = {
+        "replicas": [],
+        "balancer": _sum_balancer_stats(
+            [shard0["stats"]]
+        ),
+        "balancer_shards": [shard0, shard1],
+        "data_plane": {"balancer_procs": 2, "reuse_port": True},
+    }
+    text = fleet_to_prometheus(doc)
+    lint_prometheus_text(text)
+    assert 'glint_fleet_shard_up{shard="0"} 1' in text
+    assert 'glint_fleet_shard_up{shard="1"} 0' in text
+    assert 'glint_fleet_shard_proxied_total{shard="0"} 9' in text
+    assert ('glint_fleet_shard_requests_total'
+            '{shard="0",endpoint="/synonyms"} 8') in text
+    assert "glint_fleet_balancer_procs 2" in text
+
+
+def test_hist_window_delta_isolates_recent_traffic():
+    """The autoscaler's p95 signal must be WINDOWED: a cumulative p95
+    never decays after a surge, so idle could never be detected and
+    scale-down would never fire."""
+    from glint_word2vec_tpu.fleet import _hist_window_delta
+
+    slow = LatencyHistogram()
+    for _ in range(100):
+        slow.record(1.0)  # the surge: cumulative p95 ~1s forever
+    surged = slow.state()
+    after = LatencyHistogram.from_state(surged)
+    for _ in range(50):
+        after.record(0.001)  # calm traffic since the surge
+    window = _hist_window_delta(surged, after.state())
+    assert window.n == 50
+    assert window.quantile(0.95) < 0.1  # the window sees calm, ...
+    assert after.quantile(0.95) > 0.5   # ... the cumulative does not
+    # First observation: the cumulative state IS the window.
+    assert _hist_window_delta(None, surged).n == 100
+    # A producer restart (bucket went backwards) resets the window.
+    reset = _hist_window_delta(after.state(), surged)
+    assert reset.n == 100
+
+
+def test_sum_balancer_stats_folds_qos():
+    a = {"proxied_total": 10, "shed_retries_total": 2,
+         "exhausted_total": 1, "proxy_errors_total": 0,
+         "breaker_skips_total": 3, "restart_retries_total": 0,
+         "retry_after_honored_total": 1,
+         "qos": {"admitted_total": {"interactive": 8, "bulk": 2},
+                 "shed_total": {"tenant_quota": 1, "bulk_inflight": 0,
+                                "deadline": 0},
+                 "per_tenant_shed_total": {"job-a": 1},
+                 "bulk_inflight": 1, "bulk_inflight_peak": 2}}
+    b = {"proxied_total": 5, "shed_retries_total": 0,
+         "exhausted_total": 0, "proxy_errors_total": 2,
+         "breaker_skips_total": 0, "restart_retries_total": 1,
+         "retry_after_honored_total": 0,
+         "qos": {"admitted_total": {"interactive": 5},
+                 "shed_total": {"tenant_quota": 0, "bulk_inflight": 2,
+                                "deadline": 1},
+                 "per_tenant_shed_total": {"job-a": 2, "job-b": 1},
+                 "bulk_inflight": 0, "bulk_inflight_peak": 3}}
+    out = _sum_balancer_stats([a, b, None])
+    assert out["proxied_total"] == 15
+    assert out["retry_after_honored_total"] == 1
+    assert out["qos"]["admitted_total"] == {"interactive": 13,
+                                            "bulk": 2}
+    assert out["qos"]["per_tenant_shed_total"] == {"job-a": 3,
+                                                   "job-b": 1}
+    assert out["qos"]["bulk_inflight"] == 1
+    assert out["qos"]["bulk_inflight_peak"] == 3
+
+
+# ----------------------------------------------------------------------
+# Shard subprocesses: shared port, control channel, fan-out teardown
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_shard_processes_serve_and_tear_down():
+    """N=2 subprocess shards share the parent's listen port, answer
+    traffic, mirror control ops, and are ALL torn down by stop_all —
+    serve-fleet never leaves an orphan balancer process."""
+    reps = [_EchoReplica(), _EchoReplica()]
+    lb = LoadBalancer(
+        [r.url for r in reps], port=0, reuse_port=True, control=True,
+    )
+    lb.start_background()
+    mgr = BalancerShardManager(
+        lb, 2,
+        replica_specs=[
+            {"host": "127.0.0.1", "port": r.port, "generation": None}
+            for r in reps
+        ],
+    )
+    try:
+        mgr.start()
+        assert len(mgr.handles) == 2
+        assert all(h.proc.poll() is None for h in mgr.handles)
+        # The shared data port answers (whichever shard accepts).
+        for i in range(8):
+            code, _, _ = _post(lb.host, lb.port, "/synonyms",
+                               {"word": f"w{i}"})
+            assert code == 200
+        # Control channel: snapshots come back shard-labeled.
+        snaps = mgr.snapshots()
+        assert [s["shard"] for s in snaps] == [1, 2]
+        assert all(s["up"] for s in snaps)
+        assert all("stats" in s and "serving" in s for s in snaps)
+        # Mirror a control op to every shard.
+        mgr.broadcast({"op": "hold", "i": 0})
+        for s in mgr.snapshots():
+            assert s["breakers"][0]["held"] is True
+        status, snap = mgr.handles[0]._request(
+            "GET", "/_shard/snapshot"
+        )
+        assert status == 200 and snap["shard"] == 1
+    finally:
+        mgr.stop_all()
+        lb.stop()
+        for r in reps:
+            r.stop()
+    # Fan-out teardown left nothing behind.
+    assert all(h.proc.poll() is not None for h in mgr.handles), \
+        "orphan balancer shard process"
+
+
+@pytest.mark.slow
+def test_shard_stop_route_exits_cleanly():
+    """POST /_shard/stop tears one shard down even though it accepts
+    from a SHARED port (the bounded-accept-timeout replacement for the
+    PR 12 self-connect nudge, which cannot target one shard of a
+    shared queue)."""
+    rep = _EchoReplica()
+    lb = LoadBalancer([rep.url], port=0, reuse_port=True, control=True)
+    lb.start_background()
+    mgr = BalancerShardManager(
+        lb, 1,
+        replica_specs=[
+            {"host": "127.0.0.1", "port": rep.port, "generation": None}
+        ],
+    )
+    try:
+        mgr.start()
+        h = mgr.handles[0]
+        assert h.request_stop()
+        _wait_for(lambda: h.proc.poll() is not None, timeout=15,
+                  msg="shard exit after /_shard/stop")
+        assert h.proc.returncode == 0
+    finally:
+        mgr.stop_all()
+        lb.stop()
+        rep.stop()

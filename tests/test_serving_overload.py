@@ -219,3 +219,55 @@ def test_dispatch_fault_fails_one_request_server_survives(make_server):
         faults.disarm()
     assert len(_post(server, "/synonyms", {"word": "austria", "num": 5})) == 5
     assert _get(server, "/healthz")["status"] == "ok"
+
+
+def _post_hdr(server, path, payload, headers, timeout=30):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **headers},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_deadline_header_tightens_replica_deadline(make_server):
+    """A balancer-propagated X-Glint-Deadline-Ms can only TIGHTEN the
+    replica's own request deadline: an exhausted remote budget answers
+    504 without occupying a dispatch slot, a generous one changes
+    nothing, and the header never extends a shorter local deadline."""
+    server = make_server(max_inflight=8, request_deadline=30.0,
+                         degraded_after=None)
+    holder = _hold_lock(server, 1.0)
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_hdr(server, "/synonyms", {"word": "austria", "num": 5},
+                  {"X-Glint-Deadline-Ms": "200"})
+    assert e.value.code == 504
+    assert time.time() - t0 < 0.9  # the 200ms budget won, not the 30s
+    holder.join(timeout=30)
+    # A generous remote budget leaves the request serving normally.
+    out = _post_hdr(server, "/synonyms", {"word": "austria", "num": 5},
+                    {"X-Glint-Deadline-Ms": "60000"})
+    assert len(out) == 5
+    # A malformed header is ignored, never a 400/500.
+    out = _post_hdr(server, "/synonyms", {"word": "austria", "num": 5},
+                    {"X-Glint-Deadline-Ms": "soon"})
+    assert len(out) == 5
+    snap = _get(server, "/metrics")
+    assert snap["overload"]["deadline_504_total"] == 1
+
+
+def test_deadline_header_cannot_extend_local_deadline(make_server):
+    server = make_server(max_inflight=8, request_deadline=0.3,
+                         degraded_after=None)
+    holder = _hold_lock(server, 1.2)
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        # The remote budget is LARGER than the local deadline: min()
+        # must keep the local 0.3s in force.
+        _post_hdr(server, "/synonyms", {"word": "austria", "num": 5},
+                  {"X-Glint-Deadline-Ms": "30000"})
+    assert e.value.code == 504
+    assert time.time() - t0 < 1.1
+    holder.join(timeout=30)
