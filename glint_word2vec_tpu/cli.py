@@ -400,6 +400,31 @@ def _add_query(sub):
                            "(serve cache hits, shed misses with 429) "
                            "until the lock frees (0 disables; "
                            "default 5)")
+    mm = p.add_argument_group(
+        "multi-model serving (ISSUE 20)",
+        "one server hosting N models behind one port: route with the "
+        "/m/<id>/ path prefix or the X-Glint-Model header (no id = the "
+        "default model, full back-compat); same-shape models share "
+        "every compiled program, so model #2..N loads with zero "
+        "compiles",
+    )
+    mm.add_argument("--add-model", action="append", default=[],
+                    metavar="ID=DIR",
+                    help="load an extra named model into the catalog "
+                         "(repeatable); DIR is a saved model dir or a "
+                         "committed publish generation")
+    mm.add_argument("--model-memory-budget", default=None,
+                    metavar="BYTES",
+                    help="device-memory budget for resident tables "
+                         "(suffixes kb/mb/gb); over budget, the "
+                         "least-recently-used unpinned model is staged "
+                         "out to its committed snapshot and staged "
+                         "back in off the request path on first miss")
+    mm.add_argument("--watch-models", default=None, metavar="DIR",
+                    help="catalog root: each subdirectory with a "
+                         "LATEST.json is one model's publish dir "
+                         "(subdir name = model id), followed with its "
+                         "own hot-swap watcher")
 
     p = sub.add_parser(
         "serve-fleet",
@@ -563,6 +588,29 @@ def _add_query(sub):
                     help="concurrent in-flight cap for the bulk "
                          "priority class (X-Glint-Priority: bulk); "
                          "unset = no class cap")
+    fmm = p.add_argument_group(
+        "multi-model fleet (ISSUE 20)",
+        "every replica hosts the same model catalog behind the one "
+        "balancer port; each watched model gets its OWN rolling "
+        "rollout + canary gate, so one model's LATEST.json move never "
+        "touches another model's replica state",
+    )
+    fmm.add_argument("--add-model", action="append", default=[],
+                     metavar="ID=DIR",
+                     help="extra named model every replica loads "
+                          "(repeatable)")
+    fmm.add_argument("--watch-model", action="append", default=[],
+                     metavar="ID=DIR",
+                     help="publish dir followed for model ID with a "
+                          "per-model rolling rollout (repeatable; "
+                          "also loads the model at its newest "
+                          "committed generation when no --add-model "
+                          "pins a boot point)")
+    fmm.add_argument("--model-memory-budget", default=None,
+                     metavar="BYTES",
+                     help="per-replica resident-table budget "
+                          "(suffixes kb/mb/gb); LRU stage-out beyond "
+                          "it")
 
     p = sub.add_parser(
         "fleet-shard",
@@ -1136,11 +1184,37 @@ def _run_fleet_shard(args) -> int:
     return run_balancer_shard(args.config)
 
 
+def _parse_model_specs(pairs, flag: str):
+    """``ID=DIR`` repeatable-flag parser shared by serve/serve-fleet.
+    Returns a dict, or None after printing an error (ids ride the
+    ``/m/<id>/`` routing prefix, so ``/`` and blanks are rejected)."""
+    out = {}
+    for kv in pairs:
+        mid, sep, d = kv.partition("=")
+        if not sep or not mid or not d or "/" in mid:
+            print(
+                f"error: {flag} expects ID=DIR with a /-free id, "
+                f"got {kv!r}",
+                file=sys.stderr,
+            )
+            return None
+        out[mid] = d
+    return out
+
+
 def _run_serve_fleet(args) -> int:
     from glint_word2vec_tpu.fleet import (
         AutoscaleConfig, CanaryConfig, QosConfig, serve_fleet,
     )
 
+    models = _parse_model_specs(args.add_model, "--add-model")
+    if models is None:
+        return 1
+    model_watch_dirs = _parse_model_specs(
+        args.watch_model, "--watch-model"
+    )
+    if model_watch_dirs is None:
+        return 1
     if args.model is None and args.watch_checkpoint is None:
         print(
             "error: serve-fleet needs --model or --watch-checkpoint",
@@ -1179,7 +1253,8 @@ def _run_serve_fleet(args) -> int:
         k, v = kv.split("=", 1)
         replica0_env[k] = v
     canary = None
-    if (args.watch_checkpoint is not None and not args.no_canary
+    if ((args.watch_checkpoint is not None or model_watch_dirs)
+            and not args.no_canary
             and not args.uncoordinated_watch):
         probes = None
         if args.canary_probes:
@@ -1249,6 +1324,9 @@ def _run_serve_fleet(args) -> int:
         autoscale=autoscale,
         balancer_procs=args.balancer_procs,
         qos=qos,
+        models=models,
+        model_watch_dirs=model_watch_dirs,
+        model_memory_budget=args.model_memory_budget,
     )
 
 
@@ -1404,6 +1482,9 @@ def _run(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        models = _parse_model_specs(args.add_model, "--add-model")
+        if models is None:
+            return 1
         serve_model_dir(
             args.model, host=args.host, port=args.port,
             max_batch=args.max_batch, warmup=not args.no_warmup,
@@ -1416,6 +1497,9 @@ def _run(args) -> int:
             port_file=args.port_file,
             trace_log=args.trace_log,
             flight_dir=args.flight_dir,
+            models=models,
+            model_memory_budget=args.model_memory_budget,
+            watch_models=args.watch_models,
             **_ann_kwargs(args),
         )
         return 0

@@ -86,6 +86,19 @@ _BALANCER_PATHS = (
     "/synonyms", "/synonyms_vector", "/analogy", "/vector", "/transform",
 )
 
+
+def _strip_model_prefix(path: str) -> str:
+    """Endpoint path with any ``/m/<id>`` multi-model routing prefix
+    removed (mirrors serving.split_model_path, kept device-free here):
+    QoS admission and the balancer's per-endpoint histograms must
+    treat ``/m/a/synonyms`` as ``/synonyms`` — same admission
+    population, same bounded metric cardinality — while the full path
+    (model prefix included) is what gets forwarded to the replica."""
+    if path.startswith("/m/"):
+        sep = path.find("/", 3)
+        return path[sep:] if sep >= 0 else "/"
+    return path
+
 #: Client headers the balancer interprets (QoS admission) and forwards
 #: to the replica verbatim: tenant identity, priority class, and the
 #: remaining-deadline budget (milliseconds) the replica tightens its
@@ -1050,12 +1063,13 @@ class LoadBalancer:
         # replica slot or proxy thread is occupied.
         t0 = time.monotonic()
         decision = None
-        if self.qos is not None and url.path in _BALANCER_PATHS:
-            decision = self.qos.admit(url.path, headers)
+        ep = _strip_model_prefix(url.path)
+        if self.qos is not None and ep in _BALANCER_PATHS:
+            decision = self.qos.admit(ep, headers)
             if decision.shed is not None:
                 status, obj, retry_after = decision.shed
                 self.metrics.observe(
-                    url.path, time.monotonic() - t0, status
+                    ep, time.monotonic() - t0, status
                 )
                 return self._respond_json(
                     sock, status, obj, retry_after=retry_after
@@ -1077,7 +1091,7 @@ class LoadBalancer:
             if decision is not None:
                 self.qos.release(decision)
         tr.finish(status)
-        self.metrics.observe(url.path, time.monotonic() - t0, status)
+        self.metrics.observe(ep, time.monotonic() - t0, status)
         self._respond(
             sock, status, rbody,
             rheaders.get("content-type") or "application/json",
@@ -1811,6 +1825,25 @@ class CanaryConfig:
         self.top_k = max(1, int(top_k))
         self.probes = list(probes or [])
 
+    def scoped(self, model_id: str) -> "CanaryConfig":
+        """The same gate addressed to ONE catalog model (ISSUE 20):
+        probe and mirror paths gain the ``/m/<id>`` routing prefix, so
+        a per-model rollout canaries against that model's live
+        traffic/answers only."""
+        prefix = f"/m/{model_id}"
+        return CanaryConfig(
+            mirror_paths=tuple(prefix + p for p in self.mirror_paths),
+            mirror_every=self.mirror_every,
+            min_scores=self.min_scores,
+            mirror_seconds=self.mirror_seconds,
+            agreement_gate=self.agreement_gate,
+            top_k=self.top_k,
+            probes=[
+                {**p, "path": prefix + str(p.get("path", "/synonyms"))}
+                for p in self.probes
+            ],
+        )
+
 
 class ReplicaHoldLedger:
     """The replica-hold ownership protocol the rollout coordinator and
@@ -1925,9 +1958,21 @@ class RolloutCoordinator:
                  drain_seconds: float = 0.25,
                  replica_ok: Optional[Callable[[int], bool]] = None,
                  on_generation=None,
-                 holds: Optional[ReplicaHoldLedger] = None):
+                 holds: Optional[ReplicaHoldLedger] = None,
+                 model_id: Optional[str] = None):
         self.lb = lb
         self.watch_dir = watch_dir
+        #: Which catalog model this coordinator rolls (None = the
+        #: default). A per-model coordinator reloads through the
+        #: ``/m/<id>/`` routing prefix and reads the replica's
+        #: per-model metrics block, so one model's pointer move swaps
+        #: ONLY that model's tables — every other model's generation,
+        #: caches, and counters on the same replicas stay untouched.
+        self.model_id = model_id
+        prefix = f"/m/{model_id}" if model_id else ""
+        self._reload_path = prefix + "/reload"
+        self._metrics_path = prefix + "/metrics"
+        self._healthz_path = prefix + "/healthz"
         self.poll_seconds = max(0.05, float(poll_seconds))
         self.canary = canary
         self.step_timeout = float(step_timeout)
@@ -2195,10 +2240,12 @@ class RolloutCoordinator:
             conn.close()
 
     def _replica_metrics(self, i: int) -> Tuple[Optional[str], int, bool]:
-        """(generation, post_warmup_compiles, healthy) of one replica."""
+        """(generation, post_warmup_compiles, healthy) of one replica —
+        scoped to THIS coordinator's model (the per-model snapshot has
+        the same hot_swap/compiles shape as the top-level one)."""
         try:
-            status, snap = self.lb._get_json(i, "/metrics")
-            hstatus, _ = self.lb._get_json(i, "/healthz")
+            status, snap = self.lb._get_json(i, self._metrics_path)
+            hstatus, _ = self.lb._get_json(i, self._healthz_path)
         except Exception:
             return None, -1, False
         if status != 200:
@@ -2243,7 +2290,8 @@ class RolloutCoordinator:
         try:
             try:
                 status, resp = self._post_replica(
-                    i, "/reload", {"dir": gen_dir, "generation": gen},
+                    i, self._reload_path,
+                    {"dir": gen_dir, "generation": gen},
                     shadow=True,
                 )
             except Exception as e:
@@ -2318,7 +2366,8 @@ class RolloutCoordinator:
             restored = False
             try:
                 status, resp = self._post_replica(
-                    ci, "/reload", {"dir": gen_dir, "generation": gen},
+                    ci, self._reload_path,
+                    {"dir": gen_dir, "generation": gen},
                     shadow=True,
                 )
             except Exception as e:
@@ -2447,7 +2496,7 @@ class RolloutCoordinator:
         for _ in range(3):
             try:
                 status, _ = self._post_replica(
-                    ci, "/reload",
+                    ci, self._reload_path,
                     {"dir": prev_dir, "generation": prev_gen},
                     shadow=True,
                 )
@@ -2475,6 +2524,7 @@ class RolloutCoordinator:
                 k: v for k, v in self._stats.items() if k != "canary"
             }
             out["canary"] = dict(self._stats["canary"])
+            out["model"] = self.model_id
             out["in_progress"] = self._in_progress
             out["phase"] = self._phase
             out["generation"] = self.current
@@ -2485,9 +2535,10 @@ class RolloutCoordinator:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
+        suffix = f"-{self.model_id}" if self.model_id else ""
         self._thread = threading.Thread(
             target=self._run, daemon=True,
-            name="glint-fleet-rollout",
+            name=f"glint-fleet-rollout{suffix}",
         )
         self._thread.start()
 
@@ -3245,7 +3296,8 @@ class FleetSupervisor:
     #: afterwards; lock-free reads see either None (ignored) or the
     #: final object.
     _ATOMIC_ATTRS = frozenset({
-        "lb", "coordinator", "dp", "holds", "autoscaler", "shards",
+        "lb", "coordinator", "model_coordinators", "dp", "holds",
+        "autoscaler", "shards",
     })
 
     def __init__(
@@ -3282,6 +3334,9 @@ class FleetSupervisor:
         autoscale: Optional[AutoscaleConfig] = None,
         balancer_procs: int = 1,
         qos: Optional[QosConfig] = None,
+        models: Optional[Dict[str, str]] = None,
+        model_watch_dirs: Optional[Dict[str, str]] = None,
+        model_memory_budget=None,
     ):
         if model_dir is None and watch_dir is None \
                 and build_replica_argv is None:
@@ -3326,6 +3381,17 @@ class FleetSupervisor:
         self.balancer_procs = max(1, int(balancer_procs))
         self.qos = qos
         self.autoscale_config = autoscale
+        # -- multi-model catalog (ISSUE 20) ----------------------------
+        #: Extra model id -> model dir every replica serves besides the
+        #: default (carried to replicas as --add-model flags).
+        self.models: Dict[str, str] = dict(models or {})
+        #: model id -> publish dir: each gets its OWN rollout
+        #: coordinator, so one model's LATEST.json move rolls only
+        #: that model across the fleet.
+        self.model_watch_dirs: Dict[str, str] = dict(
+            model_watch_dirs or {}
+        )
+        self.model_memory_budget = model_memory_budget
         self._mu = threading.Lock()
         self._slots = [
             _ReplicaSlot(index=i) for i in range(self.num_replicas)
@@ -3342,6 +3408,10 @@ class FleetSupervisor:
         self.ready = threading.Event()
         self.lb: Optional[LoadBalancer] = None
         self.coordinator: Optional[RolloutCoordinator] = None
+        #: Per-model rollout coordinators (one per model_watch_dirs
+        #: entry), sharing the balancer + hold ledger with the default
+        #: coordinator. Written once in run().
+        self.model_coordinators: List[RolloutCoordinator] = []
         self.dp: Optional[_FleetDataPlane] = None
         self.holds: Optional[ReplicaHoldLedger] = None
         self.autoscaler: Optional[Autoscaler] = None
@@ -3381,6 +3451,19 @@ class FleetSupervisor:
                 os.path.join(self.trace_dir, f"replica-{index}.jsonl"),
                 "--flight-dir",
                 os.path.join(self.trace_dir, "flight"),
+            ]
+        # Multi-model catalog (ISSUE 20): every replica hosts the same
+        # model set. Watched models launch from their CURRENT promoted
+        # generation (the per-model coordinator advances it), so a
+        # relaunched replica converges with the per-model rollouts
+        # instead of racing them.
+        with self._mu:
+            catalog = dict(self.models)
+        for mid in sorted(catalog):
+            argv += ["--add-model", f"{mid}={catalog[mid]}"]
+        if self.model_memory_budget is not None:
+            argv += [
+                "--model-memory-budget", str(self.model_memory_budget)
             ]
         return argv + list(self.replica_flags)
 
@@ -3596,6 +3679,10 @@ class FleetSupervisor:
         doc = {"supervisor": sup}
         if self.coordinator is not None:
             doc["rollout"] = self.coordinator.stats()
+        if self.model_coordinators:
+            doc["model_rollouts"] = {
+                c.model_id: c.stats() for c in self.model_coordinators
+            }
         if self.autoscaler is not None:
             doc["autoscale"] = self.autoscaler.stats()
         if self.holds is not None:
@@ -3662,6 +3749,41 @@ class FleetSupervisor:
             time.sleep(max(0.5, self.watch_poll))
         return None
 
+    def _resolve_model_boots(self) -> Dict[str, str]:
+        """Boot generation name per watched catalog model (ISSUE 20).
+
+        A model that was also given a static ``--add-model`` dir INSIDE
+        its publish dir boots from that generation (the operator pinned
+        the start point); otherwise this blocks until the model's first
+        committed generation exists and records its dir in
+        ``self.models`` so every replica's ``--add-model`` argv carries
+        a loadable path."""
+        from glint_word2vec_tpu.streaming.publish import resolve_latest
+
+        boots: Dict[str, str] = {}
+        for mid in sorted(self.model_watch_dirs):
+            pub = self.model_watch_dirs[mid]
+            with self._mu:
+                static = self.models.get(mid)
+            if static is not None:
+                sd = os.path.abspath(static)
+                if os.path.dirname(sd) == os.path.abspath(pub):
+                    boots[mid] = os.path.basename(sd)
+                    continue
+            while not self._stop.is_set():
+                gen_dir = resolve_latest(pub)
+                if gen_dir is not None:
+                    with self._mu:
+                        self.models[mid] = gen_dir
+                    boots[mid] = os.path.basename(gen_dir)
+                    break
+                logger.info(
+                    "fleet: waiting for model %r's first committed "
+                    "generation in %s", mid, pub,
+                )
+                time.sleep(max(0.5, self.watch_poll))
+        return boots
+
     def _wait_initial_ready(self) -> None:
         """Block until every replica published its generation-verified
         port file; a replica dying before that is a boot error (fail
@@ -3701,6 +3823,13 @@ class FleetSupervisor:
             self._tmp = tmp
             try:
                 boot_gen = self._resolve_boot()
+                if self._stop.is_set():
+                    return 0
+                # Catalog models watched through the publish protocol
+                # must resolve to loadable dirs BEFORE the first
+                # replica launch — their paths ride every replica's
+                # --add-model argv.
+                model_boots = self._resolve_model_boots()
                 if self._stop.is_set():
                     return 0
                 if self.trace_dir:
@@ -3799,15 +3928,51 @@ class FleetSupervisor:
                         holds=self.holds,
                     )
                     self.coordinator.start()
+                if self.coordinated and self.model_watch_dirs:
+                    # One rollout coordinator per watched catalog
+                    # model: each follows its own LATEST.json and
+                    # reloads through /m/<id>/, so one model's pointer
+                    # move never swaps (or holds back) any other
+                    # model's state on the shared replicas. They share
+                    # the hold ledger with the default coordinator and
+                    # the autoscaler, so concurrent rollouts can never
+                    # double-hold a replica.
+                    mcs: List[RolloutCoordinator] = []
+                    for mid in sorted(self.model_watch_dirs):
+                        with self._mu:
+                            cur_dir = self.models.get(mid)
+                        mcs.append(RolloutCoordinator(
+                            self.lb, self.model_watch_dirs[mid],
+                            poll_seconds=self.watch_poll,
+                            current=model_boots.get(mid),
+                            current_dir=cur_dir,
+                            canary=(
+                                self.canary.scoped(mid)
+                                if self.canary is not None else None
+                            ),
+                            step_timeout=self.rollout_step_timeout,
+                            replica_ok=self._replica_ok,
+                            on_generation=self._on_model_generation(mid),
+                            holds=self.holds,
+                            model_id=mid,
+                        ))
+                    self.model_coordinators = mcs
+                    for mc in mcs:
+                        mc.start()
                 if self.warm_spares > 0 \
                         or self.autoscale_config is not None:
                     cfg = self.autoscale_config or AutoscaleConfig(
                         min_live=self.base_replicas,
                         max_live=self.num_replicas,
                     )
+                    coords = [
+                        c for c in
+                        [self.coordinator, *self.model_coordinators]
+                        if c is not None
+                    ]
                     pinned = (
-                        self.coordinator.in_progress
-                        if self.coordinator is not None else None
+                        (lambda: any(c.in_progress() for c in coords))
+                        if coords else None
                     )
                     self.autoscaler = Autoscaler(
                         holds=self.holds, config=cfg,
@@ -3853,6 +4018,8 @@ class FleetSupervisor:
                     self.autoscaler.stop()
                 if self.coordinator is not None:
                     self.coordinator.stop()
+                for mc in self.model_coordinators:
+                    mc.stop()
                 if self.shards is not None:
                     self.shards.stop_all()
                 if self.lb is not None:
@@ -3975,6 +4142,16 @@ class FleetSupervisor:
         converges instead of resurrecting an old generation)."""
         with self._mu:
             self._current_model_dir = gen_dir
+
+    def _on_model_generation(self, model_id: str):
+        """Callback factory for the per-model coordinators: promoting
+        model ``model_id``'s generation updates ONLY that model's
+        --add-model boot dir, so a replica relaunch rejoins with the
+        whole catalog at its promoted state."""
+        def cb(gen: str, gen_dir: str) -> None:
+            with self._mu:
+                self.models[model_id] = gen_dir
+        return cb
 
     def stop(self) -> None:
         self._stop.set()

@@ -466,7 +466,60 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         swap["generation"] = next(iter(swap_gens))
     elif swap_gens:
         swap["generation"] = "mixed"
-    return {
+    # Per-model blocks (ISSUE 20): group each catalog model's
+    # snapshots by id across replicas and fold every group through
+    # THIS function (a per-model snapshot nests no further models, so
+    # the recursion is exactly one level deep). The residency extras
+    # fold additively — "resident_replicas" becomes the fleet-wide
+    # count of replicas holding that model's tables on device.
+    by_model: Dict[str, list] = {}
+    for s in snaps:
+        for mid, ms in (s.get("models") or {}).items():
+            by_model.setdefault(mid, []).append(ms)
+    models = {}
+    for mid in sorted(by_model):
+        group = by_model[mid]
+        m = merge_serving_snapshots(group)
+        m["model_id"] = mid
+        m["resident_replicas"] = sum(
+            int(g.get("resident_replicas") or 0) for g in group
+        )
+        m["resident"] = m["resident_replicas"] > 0
+        m["pinned"] = any(g.get("pinned") for g in group)
+        for k in ("resident_bytes", "stage_ins_total",
+                  "evictions_total"):
+            m[k] = sum(int(g.get(k) or 0) for g in group)
+        models[mid] = m
+    # Catalog block: LRU churn counters sum across replicas; the
+    # membership/budget numbers are per-replica configuration, folded
+    # to the max so a heterogeneous fleet surfaces its largest shape.
+    catalog = None
+    cat_snaps = [s.get("catalog") for s in snaps if s.get("catalog")]
+    if cat_snaps:
+        catalog = {
+            "replicas": len(cat_snaps),
+            "default_model": cat_snaps[0].get("default_model"),
+            "models": max(
+                int(c.get("models") or 0) for c in cat_snaps
+            ),
+            "resident_models": sum(
+                int(c.get("resident_models") or 0) for c in cat_snaps
+            ),
+            "budget_bytes": max(
+                (int(c["budget_bytes"]) for c in cat_snaps
+                 if c.get("budget_bytes") is not None),
+                default=None,
+            ),
+            "stage_in_seconds_total": round(sum(
+                float(c.get("stage_in_seconds_total") or 0.0)
+                for c in cat_snaps
+            ), 3),
+        }
+        for k in ("evictions_total", "stage_ins_total",
+                  "cold_hits_total", "resident_bytes",
+                  "query_program_builds", "shared_program_hits"):
+            catalog[k] = sum(int(c.get(k) or 0) for c in cat_snaps)
+    out = {
         "replicas": len(snaps),
         "endpoints": {p: endpoints[p] for p in sorted(endpoints)},
         "coalesced_batch_sizes": {
@@ -483,6 +536,11 @@ def merge_serving_snapshots(snaps: Iterable[dict]) -> Optional[dict]:
         # corrupts the merged error budget.
         "slo": merge_slo_snapshots([s.get("slo") for s in snaps]),
     }
+    if models:
+        out["models"] = models
+    if catalog is not None:
+        out["catalog"] = catalog
+    return out
 
 
 def merge_trace_logs(paths: Iterable[str]) -> dict:

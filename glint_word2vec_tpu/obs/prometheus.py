@@ -643,6 +643,40 @@ def gang_to_prometheus(snap: dict) -> str:
             alerts = doc.get("alerts") or {}
             p.sample("glint_gang_slo_slow_burn", {"endpoint": ep},
                      1 if alerts.get("slow_burn") else 0)
+    # Per-model fleet rollup (ISSUE 20), lifted from the scraped
+    # serving replicas' merged catalog view (gang-prefixed: this
+    # exposition is concatenated with the full serving one, which
+    # carries the detailed glint_model_* families).
+    gmodels = (snap.get("serving") or {}).get("models") or {}
+    if gmodels:
+        p.head("glint_gang_model_requests_total", "counter",
+               "Requests per catalog model summed over endpoints and "
+               "serving replicas.")
+        for mid, m in sorted(gmodels.items()):
+            p.sample("glint_gang_model_requests_total", {"model": mid},
+                     sum(int(ep.get("count") or 0)
+                         for ep in (m.get("endpoints") or {}).values()))
+        p.head("glint_gang_model_resident_replicas", "gauge",
+               "Serving replicas holding this model's tables on "
+               "device.")
+        for mid, m in sorted(gmodels.items()):
+            p.sample("glint_gang_model_resident_replicas",
+                     {"model": mid}, m.get("resident_replicas", 0))
+        p.head("glint_gang_model_table_swaps_total", "counter",
+               "Per-model hot-swaps summed over serving replicas.")
+        for mid, m in sorted(gmodels.items()):
+            hs = m.get("hot_swap") or {}
+            p.sample("glint_gang_model_table_swaps_total",
+                     {"model": mid}, hs.get("table_swaps_total", 0))
+        p.head("glint_gang_model_generation_info", "gauge",
+               "Served generation per catalog model across the fleet "
+               "('mixed' while a per-model rollout is in flight); "
+               "value is always 1.")
+        for mid, m in sorted(gmodels.items()):
+            hs = m.get("hot_swap") or {}
+            p.sample("glint_gang_model_generation_info",
+                     {"model": mid,
+                      "generation": hs.get("generation") or ""}, 1)
     return p.text()
 
 
@@ -905,6 +939,147 @@ def serving_to_prometheus(snap: dict) -> str:
             alerts = doc.get("alerts") or {}
             p.sample("glint_slo_slow_burn", {"endpoint": ep},
                      1 if alerts.get("slow_burn") else 0)
+    # Per-model catalog families (ISSUE 20): the same snapshot shape
+    # as the top level, one block per catalog model, labeled {model}.
+    # Rendered only for multi-model servers/fleets, so single-model
+    # expositions are byte-identical to the pre-catalog format.
+    models = snap.get("models") or {}
+    if models:
+        p.head("glint_model_requests_total", "counter",
+               "Requests observed per catalog model and endpoint "
+               "path.")
+        for mid, m in sorted(models.items()):
+            for path, ep in (m.get("endpoints") or {}).items():
+                p.sample("glint_model_requests_total",
+                         {"model": mid, "path": path}, ep["count"])
+        p.head("glint_model_request_errors_total", "counter",
+               "Responses with status >= 400 per catalog model and "
+               "endpoint path.")
+        for mid, m in sorted(models.items()):
+            for path, ep in (m.get("endpoints") or {}).items():
+                p.sample("glint_model_request_errors_total",
+                         {"model": mid, "path": path}, ep["errors"])
+        p.head("glint_model_cache_hits_total", "counter",
+               "Synonym result-cache hits per catalog model (each "
+               "model's cache is private — a cross-model hit is "
+               "structurally impossible).")
+        for mid, m in sorted(models.items()):
+            c = m.get("synonym_cache") or {}
+            p.sample("glint_model_cache_hits_total", {"model": mid},
+                     c.get("hits", 0))
+        p.head("glint_model_cache_misses_total", "counter",
+               "Synonym result-cache misses per catalog model.")
+        for mid, m in sorted(models.items()):
+            c = m.get("synonym_cache") or {}
+            p.sample("glint_model_cache_misses_total", {"model": mid},
+                     c.get("misses", 0))
+        p.head("glint_model_post_warmup_compiles", "gauge",
+               "Compiles past this model's load warmup (0 proves "
+               "shape-keyed program sharing: a same-shape model "
+               "reuses every warmed program).")
+        for mid, m in sorted(models.items()):
+            comp = m.get("compiles") or {}
+            p.sample("glint_model_post_warmup_compiles",
+                     {"model": mid}, comp.get("post_warmup", 0))
+        p.head("glint_model_table_swaps_total", "counter",
+               "Generations hot-swapped per catalog model (a "
+               "per-model rollout moves exactly one model's count).")
+        for mid, m in sorted(models.items()):
+            hs = m.get("hot_swap") or {}
+            p.sample("glint_model_table_swaps_total", {"model": mid},
+                     hs.get("table_swaps_total", 0))
+        p.head("glint_model_swap_failures_total", "counter",
+               "Failed hot-swap attempts per catalog model.")
+        for mid, m in sorted(models.items()):
+            hs = m.get("hot_swap") or {}
+            p.sample("glint_model_swap_failures_total", {"model": mid},
+                     hs.get("swap_failures_total", 0))
+        p.head("glint_model_generation_info", "gauge",
+               "Served generation per catalog model carried as a "
+               "label; value is always 1.")
+        for mid, m in sorted(models.items()):
+            hs = m.get("hot_swap") or {}
+            p.sample("glint_model_generation_info",
+                     {"model": mid,
+                      "generation": hs.get("generation") or ""}, 1)
+        p.head("glint_model_resident_replicas", "gauge",
+               "Replicas holding this model's tables on device (a "
+               "single server reports 0 or 1; LRU stage-out drops "
+               "it).")
+        for mid, m in sorted(models.items()):
+            p.sample("glint_model_resident_replicas", {"model": mid},
+                     m.get("resident_replicas", 0))
+        p.head("glint_model_resident_bytes", "gauge",
+               "Device bytes this model's resident tables occupy "
+               "(0 while staged out).")
+        for mid, m in sorted(models.items()):
+            p.sample("glint_model_resident_bytes", {"model": mid},
+                     m.get("resident_bytes", 0))
+        p.head("glint_model_pinned", "gauge",
+               "Whether the model is pinned against LRU eviction "
+               "(default model, mid-rollout holds).")
+        for mid, m in sorted(models.items()):
+            p.sample("glint_model_pinned", {"model": mid},
+                     1 if m.get("pinned") else 0)
+        p.head("glint_model_stage_ins_total", "counter",
+               "Times this model's tables were staged back onto the "
+               "device after an eviction.")
+        for mid, m in sorted(models.items()):
+            p.sample("glint_model_stage_ins_total", {"model": mid},
+                     m.get("stage_ins_total", 0))
+        p.head("glint_model_evictions_total", "counter",
+               "Times this model's tables were staged out under "
+               "memory-budget pressure.")
+        for mid, m in sorted(models.items()):
+            p.sample("glint_model_evictions_total", {"model": mid},
+                     m.get("evictions_total", 0))
+    cat = snap.get("catalog") or {}
+    if cat:
+        p.head("glint_catalog_models", "gauge",
+               "Models installed in the serving catalog.")
+        p.sample("glint_catalog_models", None, cat.get("models", 0))
+        p.head("glint_catalog_resident_models", "gauge",
+               "Catalog models currently resident on device (summed "
+               "across replicas in the fleet view).")
+        p.sample("glint_catalog_resident_models", None,
+                 cat.get("resident_models", 0))
+        p.head("glint_catalog_budget_bytes", "gauge",
+               "Configured device-memory budget for resident tables "
+               "(NaN when unbounded).")
+        p.sample("glint_catalog_budget_bytes", None,
+                 cat.get("budget_bytes"))
+        p.head("glint_catalog_resident_bytes", "gauge",
+               "Device bytes all resident catalog tables occupy.")
+        p.sample("glint_catalog_resident_bytes", None,
+                 cat.get("resident_bytes", 0))
+        p.head("glint_catalog_evictions_total", "counter",
+               "LRU stage-outs forced by the memory budget.")
+        p.sample("glint_catalog_evictions_total", None,
+                 cat.get("evictions_total", 0))
+        p.head("glint_catalog_stage_ins_total", "counter",
+               "Cold models staged back onto the device.")
+        p.sample("glint_catalog_stage_ins_total", None,
+                 cat.get("stage_ins_total", 0))
+        p.head("glint_catalog_stage_in_seconds_total", "counter",
+               "Wall seconds spent staging evicted models back in "
+               "(off the request path; requests queue, never 500).")
+        p.sample("glint_catalog_stage_in_seconds_total", None,
+                 cat.get("stage_in_seconds_total", 0))
+        p.head("glint_catalog_cold_hits_total", "counter",
+               "Requests that arrived while their model was staged "
+               "out (each waited for the stage-in, then served).")
+        p.sample("glint_catalog_cold_hits_total", None,
+                 cat.get("cold_hits_total", 0))
+        p.head("glint_catalog_query_program_builds_total", "counter",
+               "Process-level query program builds — flat across "
+               "same-shape model loads (the sharing proof).")
+        p.sample("glint_catalog_query_program_builds_total", None,
+                 cat.get("query_program_builds", 0))
+        p.head("glint_catalog_shared_program_hits_total", "counter",
+               "Engine program lookups answered by another engine's "
+               "compiled program.")
+        p.sample("glint_catalog_shared_program_hits_total", None,
+                 cat.get("shared_program_hits", 0))
     return p.text()
 
 

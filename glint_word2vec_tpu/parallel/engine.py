@@ -213,6 +213,43 @@ def _scan_memo_put(key, fn):
     _SCAN_MEMO[key] = fn
     return fn
 
+
+#: Process-wide memo of the QUERY program family (pull, pull_average,
+#: norms, multiply, and the per-k top-k / batch-top-k factories),
+#: keyed on :meth:`EmbeddingEngine._query_memo_key` — the mesh
+#: geometry plus the query-relevant engine attributes ONLY. Unlike the
+#: scan memo, training-only attributes (negatives, compute dtype,
+#: fused-kernel mode) are deliberately EXCLUDED from the key: two
+#: models trained differently but serving the same (V, d) shape share
+#: every compiled query program, because tables and norms are traced
+#: ARGUMENTS to all of them (ISSUE 20 — loading model #2..N of a
+#: same-shape catalog triggers zero new XLA compiles). Entries hold
+#: only jit closures over specs and scalars, never table buffers.
+_QUERY_MEMO: "dict" = {}
+_QUERY_MEMO_MAX = 64
+
+#: Process-wide first-seen (geometry, op, shape) set + build counter:
+#: the number of REAL XLA query compiles this process has paid. A
+#: per-engine ``query_compiles`` tick whose (op, shape) was already
+#: seen under the same geometry is a shared-program cache hit, counted
+#: on the engine as ``shared_program_hits`` instead.
+_QUERY_SHAPES_SEEN: "set" = set()
+_QUERY_PROGRAM_BUILDS = [0]
+
+
+def query_program_builds() -> int:
+    """Process-wide count of distinct query (op, shape-bucket) programs
+    actually compiled — flat when a same-shape engine joins the warm
+    family (the multi-model zero-compile assertion)."""
+    return _QUERY_PROGRAM_BUILDS[0]
+
+
+def _query_memo_put(key, fn):
+    while len(_QUERY_MEMO) >= _QUERY_MEMO_MAX:
+        _QUERY_MEMO.pop(next(iter(_QUERY_MEMO)))
+    _QUERY_MEMO[key] = fn
+    return fn
+
 #: Floor of the top-k k-bucket family. Requested k is rounded up to
 #: ``max(next_pow2(k), TOPK_MIN_K_BUCKET)`` (capped at padded_vocab) and
 #: the result truncated to k, so every small-k request — num defaults,
@@ -1056,6 +1093,18 @@ class EmbeddingEngine:
         dcols = self.cols_per_shard
         dim_real = self.dim
 
+        def shared_query_program(op, build):
+            """Process-level program sharing (ISSUE 20): same-geometry
+            engines reuse one jitted callable — and with it one XLA
+            compile cache — because tables/norms/scalars are all traced
+            arguments. The closure the memo retains captures only specs
+            and host scalars, never device buffers."""
+            key = self._query_memo_key(op)
+            fn = _QUERY_MEMO.get(key)
+            if fn is None:
+                fn = _query_memo_put(key, build())
+            return fn
+
         def local_pull(table_l, idx):
             if dims:
                 rows = table_l[idx].astype(jnp.float32)  # (L, dl)
@@ -1066,9 +1115,9 @@ class EmbeddingEngine:
             start = lax.axis_index(MODEL_AXIS) * Vs
             return _pull_rows(table_l, idx, start, Vs, pm)
 
-        self._pull = jax.jit(
+        self._pull = shared_query_program("pull", lambda: jax.jit(
             self._shard_map(local_pull, in_specs=(tspec, rep), out_specs=rep)
-        )
+        ))
 
         def local_pull_average(table_l, idx, m):
             # idx/m: (S, L) padded sentence word-indices + validity mask.
@@ -1088,9 +1137,12 @@ class EmbeddingEngine:
                 m.sum(axis=1)[:, None], 1.0
             )
 
-        self._pull_average = jax.jit(
-            self._shard_map(
-                local_pull_average, in_specs=(tspec, rep, rep), out_specs=rep
+        self._pull_average = shared_query_program(
+            "pull_average", lambda: jax.jit(
+                self._shard_map(
+                    local_pull_average, in_specs=(tspec, rep, rep),
+                    out_specs=rep,
+                )
             )
         )
 
@@ -1105,12 +1157,12 @@ class EmbeddingEngine:
                 (table_l.astype(jnp.float32) ** 2).sum(axis=1)
             )
 
-        self._norms = jax.jit(
+        self._norms = shared_query_program("norms", lambda: jax.jit(
             self._shard_map(
                 local_norms, in_specs=(tspec,),
                 out_specs=rep if dims else P(MODEL_AXIS),
             )
-        )
+        ))
 
         def _local_cols(v):
             # Slice the replicated padded query vector down to this
@@ -1129,12 +1181,12 @@ class EmbeddingEngine:
             # matvec noted in SURVEY.md §2.3); output model-sharded.
             return table_l.astype(jnp.float32) @ v
 
-        self._multiply = jax.jit(
+        self._multiply = shared_query_program("multiply", lambda: jax.jit(
             self._shard_map(
                 local_multiply, in_specs=(tspec, rep),
                 out_specs=rep if dims else P(MODEL_AXIS),
             )
-        )
+        ))
 
         norms_spec = rep if dims else P(MODEL_AXIS)
 
@@ -1252,8 +1304,18 @@ class EmbeddingEngine:
 
         self._topk_cache: dict = {}
         self._topk_batch_cache: dict = {}
-        self._make_topk = make_topk
-        self._make_topk_batch = make_topk_batch
+        # The per-k factories consult the process memo first: a
+        # same-geometry engine's k-bucket family is the SAME jitted
+        # callable (tables/norms/queryable are traced arguments), so a
+        # second same-shape model inherits every warmed top-k program.
+        self._make_topk = lambda k: shared_query_program(
+            # graftlint: ignore[sync-point] k is a host int bucket key
+            ("topk", int(k)), lambda: make_topk(int(k))
+        )
+        self._make_topk_batch = lambda k: shared_query_program(
+            # graftlint: ignore[sync-point] k is a host int bucket key
+            ("topk_batch", int(k)), lambda: make_topk_batch(int(k))
+        )
         # Query-shape compile accounting: every distinct (op, shape
         # bucket) a query op dispatches is one XLA compile (jit
         # specializes on shape). The serving layer pads its dispatches
@@ -1261,6 +1323,11 @@ class EmbeddingEngine:
         # growing — the /metrics zero-compile contract (ISSUE 2).
         self._query_shapes: set = set()
         self.query_compiles: int = 0
+        #: First-seen shapes on THIS engine whose program was already
+        #: compiled process-wide by a same-geometry engine (the shared
+        #: warm family, ISSUE 20): a ``query_compiles`` tick that cost
+        #: zero XLA work.
+        self.shared_program_hits: int = 0
         # Lazy norms cache, invalidated by any table mutation — the engine-
         # side analogue of the reference's cached ``wordVecNorms``
         # (mllib:486). ``table_version`` ticks on the same mutations so
@@ -1672,6 +1739,25 @@ class EmbeddingEngine:
             *shape_key,
         )
 
+    def _query_memo_key(self, op):
+        """Memo key for :data:`_QUERY_MEMO`: the mesh geometry plus
+        ONLY the attributes the query closures capture — layout,
+        storage dtype, shard geometry, pallas mode. Training attributes
+        (negatives, compute dtype, fused mode) are excluded on purpose:
+        they never reach a query program, so models that differ only in
+        how they were trained still share the whole warm family."""
+        return (
+            "query", op,
+            tuple(d.id for d in self.mesh.devices.flat),
+            self.mesh.axis_names,
+            tuple(self.mesh.shape.items()),
+            self.layout,
+            str(self._dtype),
+            self._pallas_mode,
+            self.rows_per_shard, self.cols_per_shard,
+            self.padded_vocab, self.padded_dim, self.dim,
+        )
+
     def train_steps_corpus(
         self, start_position: int, batch_size: int, window: int,
         base_key, alphas, step0: int = 0
@@ -1921,9 +2007,21 @@ class EmbeddingEngine:
         if key not in self._query_shapes:
             self._query_shapes.add(key)
             self.query_compiles += 1
+            # Process-level accounting (ISSUE 20): if a same-geometry
+            # engine already dispatched this (op, shape), the shared
+            # program memo means no XLA compile actually ran — the
+            # per-engine counter keeps its first-seen-here semantics,
+            # the process counter measures real compile work.
+            pkey = self._query_memo_key("shape") + key
+            shared = pkey in _QUERY_SHAPES_SEEN
+            if shared:
+                self.shared_program_hits += 1
+            else:
+                _QUERY_SHAPES_SEEN.add(pkey)
+                _QUERY_PROGRAM_BUILDS[0] += 1
             obs_events.emit(
                 "query_compile", op=str(key[0]), shape=list(key[1:]),
-                total=self.query_compiles,
+                total=self.query_compiles, shared=shared,
             )
 
     def _k_bucket(self, k: int) -> int:
@@ -3460,6 +3558,50 @@ class EmbeddingEngine:
         self.syn0 = jax.device_put(jnp.asarray(full0, dtype=self._dtype), tsh)
         self.syn1 = jax.device_put(jnp.asarray(full1, dtype=self._dtype), tsh)
         self._tick_tables("set_tables")
+
+    def resident_bytes(self) -> int:
+        """Device bytes the live tables (+ adopted ANN index) hold —
+        the per-model cost the serving catalog's memory budget accounts
+        (ISSUE 20). Zero after :meth:`release_tables`."""
+        n = 0
+        for a in (self.syn0, self.syn1):
+            if a is not None:
+                # graftlint: ignore[sync-point] .size is array metadata
+                n += int(a.size) * a.dtype.itemsize
+        idx = self._ann
+        if idx is not None:
+            for name in ("centroids", "members", "member_invn",
+                         "member_rows"):
+                a = getattr(idx, name, None)
+                if a is not None and hasattr(a, "size"):
+                    # graftlint: ignore[sync-point] .size is metadata
+                    n += int(a.size) * a.dtype.itemsize
+        return n
+
+    @property
+    def tables_resident(self) -> bool:
+        """Whether the tables currently occupy device memory (False
+        between :meth:`release_tables` and the next adopt/stage-in)."""
+        return self.syn0 is not None
+
+    def release_tables(self) -> None:
+        """Stage-out: free the table (+ ANN index) device buffers
+        WITHOUT destroying the engine — compiled programs, vocabulary
+        geometry, and checkpoint machinery all survive, so a later
+        :meth:`stage_tables` + :meth:`adopt_tables` round trip makes
+        the engine serve again with zero new compiles. Querying while
+        released fails (callers gate on :attr:`tables_resident`);
+        unlike :meth:`destroy` the corpus/training buffers (if any)
+        are left alone."""
+        self.wait_pending_saves(reraise=False)
+        for a in (self.syn0, self.syn1):
+            try:
+                a.delete()
+            except Exception:
+                pass
+        self.syn0 = self.syn1 = None
+        self._ann = None
+        self._tick_tables("release_tables")
 
     def destroy(self) -> None:
         """Free device memory (Glint ``matrix.destroy``, mllib:665).

@@ -59,6 +59,20 @@ past ``degraded_after`` the server runs a degraded cache-only mode —
 cache hits served, misses shed with 429. Shed/deadline/degraded
 counters are on ``/metrics`` in both renderers.
 
+Multi-model serving (ISSUE 20): one process hosts N models behind one
+port through a :class:`ModelCatalog`. Every endpoint takes a model id
+— a ``/m/<id>/`` path prefix or the ``X-Glint-Model`` header; neither
+routes to the default model, so every pre-catalog client keeps
+working unchanged. Each entry owns its result cache, metrics (+SLO
+engine), and publish watcher; the compiled program family is
+process-level and shape-keyed (parallel/engine ``_QUERY_MEMO``), so a
+same-(V, d) second model warms with ZERO new XLA compiles. With
+``--model-memory-budget`` set, cold models LRU stage-out to their
+committed host snapshots (``release_tables``) and stage back in
+through ``stage_tables`` off the request path on first miss —
+requests to a staging model queue behind the bounded stage-in and are
+answered from the new tables, never a 5xx.
+
 Start from the CLI:  glint-word2vec-tpu serve --model DIR --port 8801
 """
 
@@ -71,6 +85,7 @@ import os
 import sys
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -96,6 +111,53 @@ logger = logging.getLogger(__name__)
 _DEVICE_PATHS = frozenset(
     ("/synonyms", "/synonyms_vector", "/analogy", "/vector", "/transform")
 )
+
+#: Model id every request without an explicit id routes to — the whole
+#: pre-catalog single-model surface (clients, fleet probes, CI smokes)
+#: keeps working unchanged against it.
+DEFAULT_MODEL_ID = "default"
+
+
+def split_model_path(path: str, header: Optional[str] = None):
+    """Resolve ``(model_id, endpoint_path)`` for one request (ISSUE 20).
+
+    A ``/m/<id>/<endpoint>`` path prefix wins; otherwise the
+    ``X-Glint-Model`` header names the model; otherwise ``model_id`` is
+    None (the default model). The returned endpoint path is what
+    routing, metrics keys, and the admission population see — so
+    ``/m/a/synonyms`` and a header-addressed ``/synonyms`` land in the
+    same per-model histogram bucket."""
+    if path.startswith("/m/"):
+        sep = path.find("/", 3)
+        if sep < 0:
+            return (path[3:] or None), "/"
+        return (path[3:sep] or None), (path[sep:] or "/")
+    return (header or None), path
+
+
+def parse_memory_budget(value) -> Optional[int]:
+    """``--model-memory-budget`` parser: plain bytes, or a
+    kb/mb/gb-suffixed size ("512mb", "1.5gb"). None/empty/0 disables
+    the budget (every model stays resident)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        n = int(value)
+        return n if n > 0 else None
+    s = str(value).strip().lower()
+    if not s:
+        return None
+    mult = 1
+    for suffix, m in (
+        ("kb", 1 << 10), ("mb", 1 << 20), ("gb", 1 << 30),
+        ("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30), ("b", 1),
+    ):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            mult = m
+            break
+    n = int(float(s) * mult)
+    return n if n > 0 else None
 
 
 class DeadlineExceeded(Exception):
@@ -580,9 +642,14 @@ class SnapshotWatcher:
     STAGING_ERROR_STRIKES = 5
 
     def __init__(self, server: "ModelServer", watch_dir: str,
-                 poll_seconds: float = 1.0):
+                 poll_seconds: float = 1.0,
+                 model_id: Optional[str] = None):
         self.server = server
         self.watch_dir = watch_dir
+        #: Which catalog entry this watcher swaps (None = the default
+        #: model): one model's pointer move rolls ONLY that model, and
+        #: its swap/watch-error counters land on that model's metrics.
+        self.model_id = model_id
         self.poll_seconds = max(0.05, float(poll_seconds))
         #: Current transient-error backoff (seconds; 0 while healthy —
         #: doubles per consecutive error up to BACKOFF_CAP, resets on
@@ -608,6 +675,16 @@ class SnapshotWatcher:
         self._poll_mu = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def metrics(self) -> ServingMetrics:
+        """The watched model's own metrics — per-model swap and
+        watch-error counters (ISSUE 20). Servers without a catalog
+        (duck-typed test stands-ins) expose ``.metrics`` directly."""
+        lookup = getattr(self.server, "_entry", None)
+        if lookup is None:
+            return self.server.metrics
+        return lookup(self.model_id).metrics
 
     def poll_once(self) -> Optional[str]:
         """One pointer check; returns the generation name when a swap
@@ -652,12 +729,15 @@ class SnapshotWatcher:
                 "hot-swap of %s failed: generation directory missing "
                 "after %d polls", gen, n,
             )
-            self.server.metrics.record_swap(gen, ok=False)
+            self.metrics.record_swap(gen, ok=False)
             self._failed = gen
             return None
         self._missing = (None, 0)
         try:
-            self.server.reload_generation(gen_dir, generation=gen)
+            kwargs = {"generation": gen}
+            if self.model_id is not None:
+                kwargs["model_id"] = self.model_id
+            self.server.reload_generation(gen_dir, **kwargs)
         except OSError as e:
             # The directory EXISTS but a read inside it failed: the
             # pointer only ever names committed generations, so this
@@ -674,7 +754,7 @@ class SnapshotWatcher:
                     "hot-swap of %s failed: %d consecutive staging "
                     "read errors (%s)", gen, n, e,
                 )
-                self.server.metrics.record_swap(gen, ok=False)
+                self.metrics.record_swap(gen, ok=False)
                 self._failed = gen
                 return None
             return self._watch_error_locked(
@@ -683,7 +763,7 @@ class SnapshotWatcher:
             )
         except Exception as e:
             logger.error("hot-swap of %s failed: %s", gen, e)
-            self.server.metrics.record_swap(gen, ok=False)
+            self.metrics.record_swap(gen, ok=False)
             self._failed = gen
             return None
         self.current = gen
@@ -700,15 +780,17 @@ class SnapshotWatcher:
             max(self.poll_seconds, self._backoff * 2), self.BACKOFF_CAP
         )
         self._retry_at = time.monotonic() + self._backoff
-        self.server.metrics.record_watch_error()
+        self.metrics.record_watch_error()
         logger.warning(
             "snapshot watcher: %s (retrying in %.1fs)", msg, self._backoff
         )
         return None
 
     def start(self) -> None:
+        suffix = f"-{self.model_id}" if self.model_id else ""
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="glint-snapshot-watcher"
+            target=self._run, daemon=True,
+            name=f"glint-snapshot-watcher{suffix}",
         )
         self._thread.start()
 
@@ -718,6 +800,319 @@ class SnapshotWatcher:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class ServedModel:
+    """One catalog entry (ISSUE 20): a loaded model plus everything the
+    server keys PER model — its result-cache coalescer, its
+    ServingMetrics (+SLO engine), its publish watcher, and its
+    residency state under the device-memory budget. The per-model
+    coalescer is what makes a cross-model cache hit structurally
+    impossible: each cache validates against its own engine's
+    ``table_version`` and is never consulted for another model id."""
+
+    def __init__(self, model_id: str, model, coalescer, metrics,
+                 source_dir: Optional[str] = None):
+        self.model_id = model_id
+        self.model = model
+        self.coalescer = coalescer
+        self.metrics = metrics
+        #: Committed snapshot directory (a loadable model dir) the
+        #: entry stages back in from after an eviction; refreshed by
+        #: every successful per-model hot-swap.
+        self.source_dir = source_dir
+        self.watcher: Optional["SnapshotWatcher"] = None
+        #: Pin count: a pinned entry is never staged out — the default
+        #: model (permanently), a mid-swap generation, a fleet hold or
+        #: warm spare (via POST /models/pin).
+        self.pins = 0
+        #: LRU clock: last request touch (catalog-lock guarded).
+        self.last_used = time.monotonic()
+        #: Device bytes the tables cost while resident — remembered
+        #: across stage-out so the budget can plan the stage-in.
+        self.cost_bytes = 0
+        #: Serializes stage-in: the first request to a cold model
+        #: stages; the rest queue here (bounded by their own deadlines)
+        #: and are answered from the newly resident tables.
+        self.stage_mu = threading.Lock()
+        self.stage_ins = 0
+        self.evictions = 0
+
+    @property
+    def resident(self) -> bool:
+        """Whether the tables are on device right now. Models without
+        a stage-out-capable engine always read resident."""
+        eng = getattr(self.model, "engine", None)
+        return bool(getattr(eng, "tables_resident", True))
+
+    @property
+    def evictable(self) -> bool:
+        """Only the base word-level family round-trips through
+        ``release_tables``/``stage_tables``, and only with a committed
+        snapshot to stage back from."""
+        from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+        return (
+            self.source_dir is not None
+            and type(self.model) is Word2VecModel
+        )
+
+    def resident_bytes(self) -> int:
+        """Device bytes this entry holds right now (0 when staged
+        out)."""
+        eng = getattr(self.model, "engine", None)
+        fn = getattr(eng, "resident_bytes", None)
+        if fn is None or not self.resident:
+            return 0
+        return int(fn())
+
+
+class ModelCatalog:
+    """model-id -> :class:`ServedModel` routing table plus the
+    device-memory budget (ISSUE 20).
+
+    All N models share ONE device lock, ONE admission/overload layer,
+    and ONE process-level shape-keyed compiled program family
+    (parallel/engine ``_QUERY_MEMO`` — loading a same-(V, d) model #2
+    builds zero new programs); the catalog adds per-model result
+    caches/metrics/watchers and, when ``budget_bytes`` is set, LRU
+    stage-out of cold tables to their committed host snapshots.
+    Stage-in runs OFF the request path: the winning request stages
+    (``stage_tables`` with no lock held, ``adopt_tables`` under the
+    device lock), concurrent requests queue behind ``entry.stage_mu``
+    bounded by their own deadlines and are answered from the new
+    tables — never a 5xx."""
+
+    #: Read-mostly references guarded by insertion discipline rather
+    #: than the catalog lock: ``entries`` is only ever grown (install
+    #: holds ``_mu``; dict reads are atomic under the GIL and a racing
+    #: reader simply sees the catalog before/after the install —
+    #: equally correct), ``default_id`` is written once at install
+    #: time, and ``budget_bytes`` is a boot-time scalar.
+    _ATOMIC_ATTRS = frozenset({"entries", "default_id", "budget_bytes"})
+
+    def __init__(self, server: "ModelServer",
+                 budget_bytes: Optional[int] = None):
+        self._server = server
+        self._mu = threading.Lock()
+        self.entries: "OrderedDict[str, ServedModel]" = OrderedDict()
+        self.default_id: Optional[str] = None
+        self.budget_bytes = budget_bytes
+        self.evictions = 0
+        self.stage_ins = 0
+        self.stage_in_seconds = 0.0
+        #: Requests that found their model cold (the eviction-miss
+        #: population: each either staged in or queued behind one).
+        self.cold_hits = 0
+
+    # -- membership ----------------------------------------------------
+
+    def install(self, entry: ServedModel, default: bool = False) -> None:
+        with self._mu:
+            if entry.model_id in self.entries:
+                raise ValueError(
+                    f"model id {entry.model_id!r} already served"
+                )
+            self.entries[entry.model_id] = entry
+            if default or self.default_id is None:
+                self.default_id = entry.model_id
+
+    @property
+    def default(self) -> ServedModel:
+        return self.entries[self.default_id]
+
+    def get(self, model_id: Optional[str]) -> ServedModel:
+        """Entry for a model id (None = default); KeyError -> 404."""
+        mid = model_id if model_id is not None else self.default_id
+        entry = self.entries.get(mid)
+        if entry is None:
+            raise KeyError(f"unknown model {mid!r}")
+        return entry
+
+    def ids(self):
+        return list(self.entries)
+
+    # -- pin / hold -----------------------------------------------------
+
+    def pin(self, model_id: Optional[str]) -> None:
+        """Hold a model resident: a pinned entry is never staged out
+        (rollout holds, shadow canaries, warm spares)."""
+        entry = self.get(model_id)
+        with self._mu:
+            entry.pins += 1
+
+    def unpin(self, model_id: Optional[str]) -> None:
+        entry = self.get(model_id)
+        with self._mu:
+            entry.pins = max(0, entry.pins - 1)
+
+    # -- residency ------------------------------------------------------
+
+    def touch(self, entry: ServedModel) -> None:
+        """LRU bookkeeping for one request: most-recently-used moves to
+        the back of the eviction order."""
+        with self._mu:
+            entry.last_used = time.monotonic()
+            if entry.model_id in self.entries:
+                self.entries.move_to_end(entry.model_id)
+
+    def resident_bytes(self) -> int:
+        return sum(
+            e.resident_bytes() for e in list(self.entries.values())
+        )
+
+    def ensure_resident(self, entry: ServedModel,
+                        deadline: Optional[float] = None) -> None:
+        """Return once the entry's tables are on device.
+
+        The winning request thread stages in (budget eviction first,
+        then manifest-verified reads + device assembly with NO lock
+        held, then the flip under the device lock); every concurrent
+        request to the same model queues here — bounded by its own
+        deadline — and is answered from the newly resident tables.
+        A cold model therefore costs its callers latency, never a
+        5xx."""
+        self.touch(entry)
+        if entry.resident:
+            return
+        with self._mu:
+            self.cold_hits += 1
+        if deadline is None:
+            ok = entry.stage_mu.acquire()
+        else:
+            ok = entry.stage_mu.acquire(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+        if not ok:
+            raise DeadlineExceeded("deadline waiting for model stage-in")
+        try:
+            if not entry.resident:
+                self._stage_in(entry)
+        finally:
+            entry.stage_mu.release()
+
+    def _stage_in(self, entry: ServedModel) -> None:
+        """Bring an evicted model's tables back from its committed host
+        snapshot. Caller holds ``entry.stage_mu`` (NOT the device
+        lock — staging reads disk and assembles device arrays while
+        other models keep serving)."""
+        src = entry.source_dir
+        if src is None:
+            raise ValueError(
+                f"model {entry.model_id!r} has no committed snapshot "
+                "to stage in from"
+            )
+        t0 = time.monotonic()
+        self._make_room(entry)
+        engine = entry.model.engine
+        staged = engine.stage_tables(os.path.join(src, "matrix"))
+        with self._server._lock:
+            engine.adopt_tables(staged)
+        dt = time.monotonic() - t0
+        with self._mu:
+            entry.cost_bytes = entry.resident_bytes()
+            entry.stage_ins += 1
+            self.stage_ins += 1
+            self.stage_in_seconds += dt
+        logger.info(
+            "staged model %r back in from %s (%.2fs, %d bytes)",
+            entry.model_id, src, dt, entry.cost_bytes,
+        )
+
+    def _make_room(self, entry: Optional[ServedModel]) -> None:
+        """Evict LRU unpinned models until ``entry`` (or, with None,
+        the current residency) fits the budget. With nothing evictable
+        left the catalog runs over budget rather than failing requests
+        — the budget is a target, pins are a guarantee."""
+        budget = self.budget_bytes
+        if not budget:
+            return
+        need = max(0, entry.cost_bytes) if entry is not None else 0
+        while True:
+            with self._mu:
+                used = sum(
+                    e.resident_bytes() for e in self.entries.values()
+                )
+                if used + need <= budget:
+                    return
+                victim = None
+                for e in self.entries.values():  # LRU iteration order
+                    if e is entry or not e.resident:
+                        continue
+                    if e.pins == 0 and e.evictable:
+                        victim = e
+                        break
+            if victim is None:
+                logger.warning(
+                    "model-memory budget exceeded (%d resident + %d "
+                    "needed > %d) with nothing evictable — running "
+                    "over budget", used, need, budget,
+                )
+                return
+            self.evict(victim)
+
+    def evict(self, entry: ServedModel) -> bool:
+        """Stage one model's tables out of device memory. The bytes
+        are already safe on disk (the committed snapshot in
+        ``source_dir``), so eviction is pure release — pending async
+        saves are drained first inside ``release_tables``."""
+        with self._mu:
+            if entry.pins or not entry.evictable or not entry.resident:
+                return False
+        engine = entry.model.engine
+        with self._server._lock:
+            with self._mu:
+                if entry.pins:  # pinned in the race window
+                    return False
+                entry.cost_bytes = (
+                    entry.resident_bytes() or entry.cost_bytes
+                )
+            engine.release_tables()
+        with self._mu:
+            entry.evictions += 1
+            self.evictions += 1
+        logger.info(
+            "staged model %r out (%d bytes freed; snapshot %s)",
+            entry.model_id, entry.cost_bytes, entry.source_dir,
+        )
+        return True
+
+    def enforce_budget(self) -> None:
+        """Re-establish the budget after a load/reload grew residency."""
+        self._make_room(None)
+
+    # -- exposition ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Catalog block for /metrics: membership, residency vs budget,
+        LRU churn counters, and the process-level program-sharing
+        proof (builds vs shared-hit counts)."""
+        from glint_word2vec_tpu.parallel.engine import (
+            query_program_builds,
+        )
+
+        with self._mu:
+            entries = list(self.entries.values())
+            doc = {
+                "models": len(entries),
+                "default_model": self.default_id,
+                "budget_bytes": self.budget_bytes,
+                "evictions_total": self.evictions,
+                "stage_ins_total": self.stage_ins,
+                "stage_in_seconds_total": round(
+                    self.stage_in_seconds, 3
+                ),
+                "cold_hits_total": self.cold_hits,
+            }
+        doc["resident_models"] = sum(1 for e in entries if e.resident)
+        doc["resident_bytes"] = sum(e.resident_bytes() for e in entries)
+        doc["query_program_builds"] = query_program_builds()
+        shared = 0
+        for e in entries:
+            eng = getattr(e.model, "engine", None)
+            shared += int(getattr(eng, "shared_program_hits", 0) or 0)
+        doc["shared_program_hits"] = shared
+        return doc
 
 
 class ModelServer:
@@ -822,6 +1217,27 @@ class ModelServer:
             cache_size=cache_size,
         )
         self.max_batch = self._coalescer.max_batch
+        self.cache_size = max(0, int(cache_size))
+        #: Serving warm family parameters, reused verbatim by
+        #: ``add_model`` so every catalog entry warms the SAME shape
+        #: family — same-(V, d) models then share every compiled
+        #: program through the process-level memo.
+        self._warm_params = (
+            tuple(warm_ks),
+            tuple(warm_sentence_lens),
+            tuple(warm_sentence_rows),
+        )
+        self._do_warmup = bool(warmup)
+        # -- model catalog (ISSUE 20) ----------------------------------
+        self.catalog = ModelCatalog(self)
+        _default_entry = ServedModel(
+            DEFAULT_MODEL_ID, model, self._coalescer, self.metrics
+        )
+        #: The default model is permanently pinned: the back-compat
+        #: single-model surface must never stage out under budget
+        #: pressure.
+        _default_entry.pins = 1
+        self.catalog.install(_default_entry, default=True)
         # -- approximate top-k (ISSUE 12) ------------------------------
         #: Whether the two-stage device index serves default /synonyms
         #: traffic. Only the base word-level family (the batching
@@ -911,54 +1327,73 @@ class ModelServer:
                 # the query string (?format=... would otherwise mint a
                 # fresh latency histogram per variant).
                 url = urlparse(self.path)
+                mid, path = split_model_path(
+                    url.path, self.headers.get("X-Glint-Model")
+                )
                 try:
-                    if url.path == "/healthz":
-                        m = server.model
-                        compiles = server._query_compiles()
+                    entry = server._entry(mid)
+                except KeyError:
+                    self._send(404, {"error": f"unknown model {mid!r}"})
+                    server._observe_request(
+                        server.catalog.default, path,
+                        time.perf_counter() - t0, 404,
+                    )
+                    return
+                try:
+                    if path == "/healthz":
+                        m = entry.model
+                        compiles = server._query_compiles(entry)
                         degraded = server._degraded()
-                        self._send(
+                        doc = {
                             # Degraded is still alive-but-impaired: 200
                             # with the flag (a 5xx here would make the
                             # fleet LB pull a server that is shedding
                             # exactly as designed).
-                            200,
-                            {
-                                "status": (
-                                    "degraded" if degraded else "ok"
-                                ),
-                                "family": type(m).__name__,
-                                "vocab_size": m.vocab.size,
-                                "dim": m.vector_size,
-                                "max_batch": server.max_batch,
-                                "compiles": compiles,
-                                "post_warmup_compiles": compiles
-                                - server.metrics.warmup_compiles,
-                                "max_inflight": server.max_inflight,
-                                "request_deadline_seconds":
-                                    server.request_deadline,
-                                "degraded_after_seconds":
-                                    server.degraded_after,
-                                "ann_enabled": server._ann_live,
-                                "ann_recall_gate_ok":
-                                    server.metrics.index_recall_gate_ok,
-                                "generation":
-                                    server.metrics.generation,
-                                "fleet_generation":
-                                    server.fleet_generation,
-                            },
-                        )
-                    elif url.path == "/metrics":
-                        snap = server.metrics.snapshot(
-                            server._query_compiles(),
-                            checkpoint=server._checkpoint_stats(),
-                            index_staleness=server._index_staleness(),
-                        )
+                            "status": (
+                                "degraded" if degraded else "ok"
+                            ),
+                            "model": entry.model_id,
+                            "family": type(m).__name__,
+                            "vocab_size": m.vocab.size,
+                            "dim": m.vector_size,
+                            "max_batch": server.max_batch,
+                            "compiles": compiles,
+                            "post_warmup_compiles": compiles
+                            - entry.metrics.warmup_compiles,
+                            "max_inflight": server.max_inflight,
+                            "request_deadline_seconds":
+                                server.request_deadline,
+                            "degraded_after_seconds":
+                                server.degraded_after,
+                            "ann_enabled": server._ann_live,
+                            "ann_recall_gate_ok":
+                                server.metrics.index_recall_gate_ok,
+                            "generation":
+                                entry.metrics.generation,
+                            "fleet_generation":
+                                server.fleet_generation,
+                            "resident": entry.resident,
+                        }
+                        if mid is None and len(server.catalog.entries) > 1:
+                            doc["models"] = server._models_summary()
+                        self._send(200, doc)
+                    elif path == "/metrics":
+                        # Scoped /m/<id>/metrics answers ONE model's
+                        # block; the bare path keeps the default
+                        # model's snapshot at the root (back-compat)
+                        # with per-model + catalog blocks folded in.
+                        if mid is not None:
+                            snap = server._entry_snapshot(entry)
+                        else:
+                            snap = server._metrics_doc()
                         fmt = parse_qs(url.query).get("format", ["json"])[0]
                         if fmt == "prometheus":
                             self._send_text(200, serving_to_prometheus(snap))
                         else:
                             self._send(200, snap)
-                    elif url.path == "/trace":
+                    elif path == "/models":
+                        self._send(200, server._models_doc())
+                    elif path == "/trace":
                         # Flight-recorder scrape: the last N seconds of
                         # this process's span ring plus the clock anchor,
                         # so the balancer's postmortem bundle can rebase
@@ -979,10 +1414,11 @@ class ModelServer:
                                            "mono_t0": rec.mono_t0},
                             })
                     else:
-                        self._send(404, {"error": f"no route {url.path}"})
+                        self._send(404, {"error": f"no route {path}"})
                 finally:
                     server._observe_request(
-                        url.path, time.perf_counter() - t0, self._status
+                        entry, path, time.perf_counter() - t0,
+                        self._status,
                     )
 
             def do_POST(self):
@@ -990,7 +1426,10 @@ class ModelServer:
                 self._status = 500
                 # Same parsed-path rule as do_GET: routing and metric
                 # keys must not vary with the query string.
-                path = urlparse(self.path).path
+                mid, path = split_model_path(
+                    urlparse(self.path).path,
+                    self.headers.get("X-Glint-Model"),
+                )
                 # Distributed tracing (ISSUE 18): adopt the propagated
                 # trace id (the balancer's X-Glint-Trace) or mint one at
                 # the edge. Phase spans buffer on the trace and flush
@@ -1001,16 +1440,27 @@ class ModelServer:
                 )
                 self._trace = tr
                 try:
+                    entry = server._entry(mid)
+                except KeyError:
+                    self._send(404, {"error": f"unknown model {mid!r}"})
+                    tr.finish(404)
+                    server._observe_request(
+                        server.catalog.default, path,
+                        time.perf_counter() - t0, 404,
+                    )
+                    return
+                try:
                     with tr.phase("req.accept", path=path):
-                        self._handle_post(path)
+                        self._handle_post(path, entry)
                 finally:
                     kept = tr.finish(self._status)
                     server._observe_request(
-                        path, time.perf_counter() - t0, self._status,
+                        entry, path, time.perf_counter() - t0,
+                        self._status,
                         trace_id=tr.trace_id if kept else None,
                     )
 
-            def _handle_post(self, path):
+            def _handle_post(self, path, entry):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -1025,7 +1475,7 @@ class ModelServer:
                         admitted = server._admit()
                         adm.update(admitted=admitted)
                     if not admitted:
-                        server._record_shed("admission")
+                        server._record_shed("admission", entry)
                         return self._send(
                             429,
                             {"error": "server overloaded "
@@ -1033,33 +1483,36 @@ class ModelServer:
                             headers={"Retry-After": "1"},
                         )
                     try:
-                        return self._handle_device(path, req)
+                        return self._handle_device(path, req, entry)
                     finally:
                         server._release_slot()
                 out = None
                 if path == "/reload":
-                    # Admin hot-swap: explicit generation dir, or an
-                    # immediate poll of the watched publish dir. Not a
-                    # _DEVICE_PATHS member — an overloaded server must
-                    # still be swappable (staging runs lock-free; the
-                    # flip queues behind in-flight dispatches only).
+                    # Admin hot-swap of THIS entry's model: explicit
+                    # generation dir, or an immediate poll of its
+                    # watched publish dir. Not a _DEVICE_PATHS member —
+                    # an overloaded server must still be swappable
+                    # (staging runs lock-free; the flip queues behind
+                    # in-flight dispatches only).
                     if "dir" in req:
                         gen_dir = str(req["dir"])
                         gen = req.get("generation") or os.path.basename(
                             os.path.normpath(gen_dir)
                         )
-                        # Serialize against the watcher's poll thread —
-                        # an explicit reload racing a pointer poll must
-                        # not stage/adopt the same generation twice.
+                        # Serialize against the entry's watcher poll
+                        # thread — an explicit reload racing a pointer
+                        # poll must not stage/adopt the same generation
+                        # twice.
                         mu = (
-                            server.watcher._poll_mu
-                            if server.watcher is not None
+                            entry.watcher._poll_mu
+                            if entry.watcher is not None
                             else contextlib.nullcontext()
                         )
                         with mu:
                             try:
                                 server.reload_generation(
-                                    gen_dir, generation=gen
+                                    gen_dir, generation=gen,
+                                    model_id=entry.model_id,
                                 )
                             except OSError as e:
                                 if os.path.isdir(gen_dir):
@@ -1072,38 +1525,62 @@ class ModelServer:
                                     # SnapshotWatcher classification,
                                     # preserved across the HTTP
                                     # boundary).
-                                    server.metrics.record_watch_error()
+                                    entry.metrics.record_watch_error()
                                     return self._send(
                                         503,
                                         {"error": "transient staging "
                                                   f"error: {e}"},
                                         headers={"Retry-After": "1"},
                                     )
-                                server.metrics.record_swap(gen, ok=False)
+                                entry.metrics.record_swap(gen, ok=False)
                                 return self._send(400, {"error": str(e)})
                             except Exception as e:
-                                server.metrics.record_swap(gen, ok=False)
+                                entry.metrics.record_swap(gen, ok=False)
                                 return self._send(400, {"error": str(e)})
-                            if server.watcher is not None:
-                                server.watcher.current = gen
+                            if entry.watcher is not None:
+                                entry.watcher.current = gen
                         return self._send(
-                            200, {"status": "reloaded", "generation": gen}
+                            200, {"status": "reloaded", "generation": gen,
+                                  "model": entry.model_id}
                         )
-                    if server.watcher is None:
+                    if entry.watcher is None:
                         return self._send(
                             400,
-                            {"error": "no --watch-checkpoint dir "
-                                      'configured; pass {"dir": ...}'},
+                            {"error": "no watched publish dir for "
+                                      f"model {entry.model_id!r}; "
+                                      'pass {"dir": ...}'},
                         )
-                    gen = server.watcher.poll_once()
+                    gen = entry.watcher.poll_once()
                     if gen is None:
                         return self._send(
                             200,
                             {"status": "unchanged",
-                             "generation": server.watcher.current},
+                             "generation": entry.watcher.current,
+                             "model": entry.model_id},
                         )
                     return self._send(
-                        200, {"status": "reloaded", "generation": gen}
+                        200, {"status": "reloaded", "generation": gen,
+                              "model": entry.model_id}
+                    )
+                if path == "/models/pin":
+                    # Pin/hold admin surface: the fleet's rollout
+                    # coordinator and autoscaler pin the model they are
+                    # rolling/warming so the LRU can never stage it out
+                    # from under a held generation or a warm spare.
+                    target = req.get("model", entry.model_id)
+                    try:
+                        if bool(req.get("pinned", True)):
+                            server.catalog.pin(target)
+                        else:
+                            server.catalog.unpin(target)
+                        pins = server.catalog.get(target).pins
+                    except KeyError:
+                        return self._send(
+                            404, {"error": f"unknown model {target!r}"}
+                        )
+                    return self._send(
+                        200, {"model": target or DEFAULT_MODEL_ID,
+                              "pins": pins}
                     )
                 if path == "/shutdown":
                     with server._lock:
@@ -1115,9 +1592,10 @@ class ModelServer:
                     return
                 self._send(404, {"error": f"no route {path}"})
 
-            def _handle_device(self, path, req):
+            def _handle_device(self, path, req, entry):
                 """One admitted device-touching request: degraded-mode
-                gate, per-request deadline, then dispatch."""
+                gate, per-request deadline, residency (the LRU
+                stage-in rendezvous), then dispatch."""
                 if server._degraded():
                     # Cache-only mode: the device is wedged — serve
                     # what needs no dispatch, shed the rest. 429 (not
@@ -1133,16 +1611,16 @@ class ModelServer:
                             return self._send(
                                 400, {"error": f"bad num: {e}"}
                             )
-                        hit = server._coalescer.cache_lookup(
+                        hit = entry.coalescer.cache_lookup(
                             req.get("word"), num,
                             exact=bool(req.get("exact", False)),
                         )
                         if hit is not None:
-                            server.metrics.record_cache(True)
+                            entry.metrics.record_cache(True)
                             return self._send(
                                 200, [[w, float(s)] for w, s in hit]
                             )
-                    server._record_shed("degraded")
+                    server._record_shed("degraded", entry)
                     return self._send(
                         429,
                         {"error": "degraded cache-only mode "
@@ -1170,10 +1648,17 @@ class ModelServer:
                             else min(deadline, remote)
                         )
                 try:
+                    # LRU rendezvous: a cold model stages back in OFF
+                    # the request path (the winning thread stages, the
+                    # rest queue bounded by their deadlines) before
+                    # any dispatch below touches its tables.
+                    server.catalog.ensure_resident(
+                        entry, deadline=deadline
+                    )
                     if path == "/synonyms":
                         out = [
                             [w, float(s)]
-                            for w, s in server._coalescer.query(
+                            for w, s in entry.coalescer.query(
                                 word=req["word"],
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
@@ -1184,7 +1669,7 @@ class ModelServer:
                     elif path == "/synonyms_vector":
                         out = [
                             [w, float(s)]
-                            for w, s in server._coalescer.query(
+                            for w, s in entry.coalescer.query(
                                 vector=req["vector"],
                                 num=int(req.get("num", 10)),
                                 deadline=deadline,
@@ -1208,11 +1693,13 @@ class ModelServer:
                             with self._trace.phase(
                                 "req.query", mode="exact"
                             ):
-                                out = server._dispatch(path, req)
+                                out = server._dispatch(
+                                    path, req, entry.model
+                                )
                         finally:
                             server._lock.release()
                 except DeadlineExceeded as e:
-                    server.metrics.record_deadline()
+                    entry.metrics.record_deadline()
                     return self._send(504, {"error": str(e)})
                 except KeyError as e:
                     return self._send(
@@ -1227,13 +1714,143 @@ class ModelServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
-        self.watcher: Optional[SnapshotWatcher] = None
         # Mode suppliers LAST: no request thread exists yet, and the
         # coalescer must never see ann before the gate ran.
         self._coalescer.ann_active = lambda: self._ann_live
         self._coalescer.gate_failing = (
             lambda: self.ann and not self._ann_live
         )
+
+    # -- model catalog (ISSUE 20) ---------------------------------------
+
+    @property
+    def watcher(self) -> Optional[SnapshotWatcher]:
+        """The DEFAULT model's publish watcher (back-compat alias —
+        each catalog entry owns its own watcher)."""
+        return self.catalog.default.watcher
+
+    @watcher.setter
+    def watcher(self, w: Optional[SnapshotWatcher]) -> None:
+        self.catalog.default.watcher = w
+
+    def _entry(self, model_id: Optional[str]) -> ServedModel:
+        """Catalog entry for a request's model id (None = default);
+        KeyError -> the handler's 404."""
+        return self.catalog.get(model_id)
+
+    def add_model(self, model_id: str, model=None,
+                  model_dir: Optional[str] = None, *,
+                  warmup: Optional[bool] = None,
+                  generation: Optional[str] = None) -> ServedModel:
+        """Serve another model from this process (ISSUE 20).
+
+        The new entry gets its OWN result cache, metrics, and SLO
+        engine, but shares the device lock, the admission layer, and —
+        decisively — the process-level shape-keyed program memo: the
+        warmup below re-walks the exact bucket family the default
+        model compiled, so a same-(V, d) model costs ZERO new XLA
+        programs (``query_program_builds()`` is the proof the bench
+        gates assert on). The ANN lifecycle stays a default-model
+        feature; catalog models serve the exact path."""
+        from glint_word2vec_tpu import load_model
+        from glint_word2vec_tpu.streaming.publish import _GEN_RE
+
+        if model is None:
+            if model_dir is None:
+                raise ValueError("add_model needs model or model_dir")
+            model = load_model(model_dir)
+        metrics = ServingMetrics()
+        metrics.slo = SloEngine.default_serving(_DEVICE_PATHS)
+        coalescer = _SynonymCoalescer(
+            model, self._lock, max_batch=self.max_batch,
+            metrics=metrics, cache_size=self.cache_size,
+        )
+        entry = ServedModel(
+            model_id, model, coalescer, metrics, source_dir=model_dir
+        )
+        if generation is None and model_dir is not None:
+            base = os.path.basename(os.path.normpath(model_dir))
+            if _GEN_RE.match(base):
+                generation = base
+        if generation is not None:
+            metrics.generation = generation
+        self.catalog.install(entry)
+        do_warm = self._do_warmup if warmup is None else bool(warmup)
+        if do_warm and coalescer.can_batch:
+            warm_ks, warm_lens, warm_rows = self._warm_params
+            q_buckets = [
+                1 << i for i in range(self.max_batch.bit_length())
+            ]
+            model.engine.warmup(
+                q_buckets, warm_ks,
+                sentence_lens=warm_lens, sentence_rows=warm_rows,
+            )
+        metrics.warmup_compiles = self._query_compiles(entry)
+        self.catalog.enforce_budget()
+        logger.info(
+            "added model %r (%d words, dim %d, resident %s)",
+            model_id, model.vocab.size, model.vector_size,
+            entry.resident,
+        )
+        return entry
+
+    def _models_summary(self) -> dict:
+        """Per-model overview for /healthz and GET /models."""
+        out = {}
+        for mid, e in list(self.catalog.entries.items()):
+            compiles = self._query_compiles(e)
+            out[mid] = {
+                "family": type(e.model).__name__,
+                "vocab_size": e.model.vocab.size,
+                "dim": e.model.vector_size,
+                "resident": e.resident,
+                "pinned": e.pins > 0,
+                "generation": e.metrics.generation,
+                "post_warmup_compiles": compiles
+                - e.metrics.warmup_compiles,
+            }
+        return out
+
+    def _models_doc(self) -> dict:
+        return {
+            "default": self.catalog.default_id,
+            "models": self._models_summary(),
+            "catalog": self.catalog.snapshot(),
+        }
+
+    def _entry_snapshot(self, entry: ServedModel) -> dict:
+        """One model's full metrics snapshot + its residency state."""
+        is_default = entry is self.catalog.default
+        snap = entry.metrics.snapshot(
+            self._query_compiles(entry),
+            checkpoint=self._checkpoint_stats(entry),
+            index_staleness=(
+                self._index_staleness(entry) if is_default else None
+            ),
+        )
+        snap["model_id"] = entry.model_id
+        snap["resident"] = entry.resident
+        # Integer twin of "resident" so the merged fleet view can fold
+        # it additively (resident replica count per model) and the
+        # Prometheus renderer maps ONE key in both shapes.
+        snap["resident_replicas"] = 1 if entry.resident else 0
+        snap["pinned"] = entry.pins > 0
+        snap["resident_bytes"] = entry.resident_bytes()
+        snap["stage_ins_total"] = entry.stage_ins
+        snap["evictions_total"] = entry.evictions
+        return snap
+
+    def _metrics_doc(self) -> dict:
+        """The top-level /metrics document: the default model's
+        snapshot at the root (every pre-catalog consumer keeps
+        parsing), plus per-model blocks and the catalog block."""
+        doc = self._entry_snapshot(self.catalog.default)
+        doc["models"] = {
+            mid: self._entry_snapshot(e)
+            for mid, e in list(self.catalog.entries.items())
+        }
+        doc["catalog"] = self.catalog.snapshot()
+        return doc
 
     # -- approximate index lifecycle (ISSUE 12) ------------------------
 
@@ -1274,9 +1891,12 @@ class ModelServer:
             )
         return recall, ok
 
-    def _index_staleness(self) -> Optional[int]:
+    def _index_staleness(
+        self, entry: Optional[ServedModel] = None
+    ) -> Optional[int]:
         """Table versions the live index is behind (None = no index)."""
-        eng = getattr(self.model, "engine", None)
+        model = (entry or self.catalog.default).model
+        eng = getattr(model, "engine", None)
         idx = getattr(eng, "ann_index", None)
         if eng is None or idx is None:
             return None
@@ -1285,26 +1905,35 @@ class ModelServer:
     # -- hot-swap (ISSUE 10) ------------------------------------------
 
     def watch(self, watch_dir: str, poll_seconds: float = 1.0,
-              current: Optional[str] = None) -> SnapshotWatcher:
-        """Follow a publish directory: every new committed generation
-        is staged off the request path and flipped in atomically.
-        ``current`` names the generation already loaded at startup so
-        the first poll doesn't re-load it."""
-        self.watcher = SnapshotWatcher(self, watch_dir, poll_seconds)
-        self.watcher.current = current
-        if current is not None:
-            self.metrics.generation = current
-        self.watcher.start()
-        logger.info(
-            "watching %s for published generations (poll %.2fs)",
-            watch_dir, poll_seconds,
+              current: Optional[str] = None,
+              model_id: Optional[str] = None) -> SnapshotWatcher:
+        """Follow a publish directory for ONE model (None = default):
+        every new committed generation is staged off the request path
+        and flipped into that model only. ``current`` names the
+        generation already loaded at startup so the first poll doesn't
+        re-load it."""
+        entry = self._entry(model_id)
+        w = SnapshotWatcher(
+            self, watch_dir, poll_seconds, model_id=model_id
         )
-        return self.watcher
+        w.current = current
+        if current is not None:
+            entry.metrics.generation = current
+        entry.watcher = w
+        w.start()
+        logger.info(
+            "watching %s for published generations of model %r "
+            "(poll %.2fs)", watch_dir, entry.model_id, poll_seconds,
+        )
+        return w
 
     def reload_generation(self, gen_dir: str,
-                          generation: Optional[str] = None) -> None:
-        """Hot-swap the served tables to a committed generation
-        directory (a model dir: ``matrix/`` + ``words.txt``).
+                          generation: Optional[str] = None,
+                          model_id: Optional[str] = None) -> None:
+        """Hot-swap ONE model's served tables (None = the default) to
+        a committed generation directory (a model dir: ``matrix/`` +
+        ``words.txt``). Other catalog entries are untouched — their
+        caches, generations, and swap counters never move.
 
         Staging — manifest verification, disk reads, building the
         re-sharded device arrays, and (with the index enabled)
@@ -1323,50 +1952,61 @@ class ModelServer:
         from glint_word2vec_tpu.corpus.vocab import saved_model_vocabulary
         from glint_word2vec_tpu.models.word2vec import Word2VecModel
 
+        entry = self._entry(model_id)
         faults.fire("serving.reload")
-        if type(self.model) is not Word2VecModel:
+        if type(entry.model) is not Word2VecModel:
             raise ValueError(
                 f"hot-swap supports the base word-level family only "
-                f"(serving a {type(self.model).__name__})"
+                f"(serving a {type(entry.model).__name__})"
             )
-        engine = self.model.engine
-        staged = engine.stage_tables(os.path.join(gen_dir, "matrix"))
-        meta = staged["meta"]
-        vocab = saved_model_vocabulary(
-            gen_dir,
-            np.load(os.path.join(gen_dir, "matrix", "counts.npy")),
-            int(meta["vocab_size"]) + int(
-                meta.get("extra_rows_assigned", 0)
-            ),
-        )
-        staged_ann = None
-        staged_ok = False
-        if self.ann:
-            # Refresh the coarse index against the STAGED tables — new
-            # centroids, fresh member packing, and the recall gate all
-            # run off the request path; only the flip below is held.
-            staged_q = int(meta["vocab_size"]) + int(
-                meta.get("extra_rows_assigned", 0)
+        # Pinned for the duration: the LRU must never stage out the
+        # generation being swapped in (the rollout-hold guarantee).
+        self.catalog.pin(model_id)
+        try:
+            engine = entry.model.engine
+            staged = engine.stage_tables(os.path.join(gen_dir, "matrix"))
+            meta = staged["meta"]
+            vocab = saved_model_vocabulary(
+                gen_dir,
+                np.load(os.path.join(gen_dir, "matrix", "counts.npy")),
+                int(meta["vocab_size"]) + int(
+                    meta.get("extra_rows_assigned", 0)
+                ),
             )
-            staged_norms = engine._norms(staged["syn0"])
-            staged_ann = engine.ann_build(
-                staged["syn0"], staged_norms, staged_q
-            )
-            _, staged_ok = self._gate_index(
-                engine, generation, index=staged_ann,
-                syn0=staged["syn0"], norms=staged_norms,
-                queryable=staged_q,
-            )
-        with self._lock:
-            engine.adopt_tables(staged)
-            self.model.vocab = vocab
-            if staged_ann is not None:
-                engine.adopt_ann(staged_ann)
-                self._ann_live = staged_ok
-        self.metrics.record_swap(generation, ok=True)
+            staged_ann = None
+            staged_ok = False
+            if self.ann and entry is self.catalog.default:
+                # Refresh the coarse index against the STAGED tables —
+                # new centroids, fresh member packing, and the recall
+                # gate all run off the request path; only the flip
+                # below is held.
+                staged_q = int(meta["vocab_size"]) + int(
+                    meta.get("extra_rows_assigned", 0)
+                )
+                staged_norms = engine._norms(staged["syn0"])
+                staged_ann = engine.ann_build(
+                    staged["syn0"], staged_norms, staged_q
+                )
+                _, staged_ok = self._gate_index(
+                    engine, generation, index=staged_ann,
+                    syn0=staged["syn0"], norms=staged_norms,
+                    queryable=staged_q,
+                )
+            with self._lock:
+                engine.adopt_tables(staged)
+                entry.model.vocab = vocab
+                if staged_ann is not None:
+                    engine.adopt_ann(staged_ann)
+                    self._ann_live = staged_ok
+            entry.metrics.record_swap(generation, ok=True)
+            entry.source_dir = gen_dir
+        finally:
+            self.catalog.unpin(model_id)
+        self.catalog.enforce_budget()
         logger.info(
-            "hot-swapped to %s (%d words, table_version %d%s)",
-            generation or gen_dir, len(vocab.words), engine.table_version,
+            "hot-swapped %r to %s (%d words, table_version %d%s)",
+            entry.model_id, generation or gen_dir, len(vocab.words),
+            engine.table_version,
             ", index refreshed" if staged_ann is not None else "",
         )
 
@@ -1413,24 +2053,28 @@ class ModelServer:
 
     # -- SLO + anomaly flight recorder (ISSUE 18) ---------------------
 
-    def _observe_request(self, path: str, seconds: float, status: int,
+    def _observe_request(self, entry: ServedModel, path: str,
+                         seconds: float, status: int,
                          trace_id: Optional[str] = None) -> None:
-        """Single funnel for per-request accounting: the latency
-        histogram + SLO observation (with the exemplar trace id when
-        the tail sampler kept the trace), then the SLO fast-burn
-        flight-recorder trigger (throttled inside the engine)."""
-        self.metrics.observe(
+        """Single funnel for per-request accounting, keyed to the
+        request's MODEL: the latency histogram + SLO observation (with
+        the exemplar trace id when the tail sampler kept the trace),
+        then the SLO fast-burn flight-recorder trigger (throttled
+        inside the engine)."""
+        entry.metrics.observe(
             path, seconds, status=status, trace_id=trace_id
         )
-        fl, slo = self.flight, self.metrics.slo
+        fl, slo = self.flight, entry.metrics.slo
         if fl is not None and slo is not None:
             for ep in slo.fast_burn_transitions():
                 fl.trigger("slo_fast_burn", endpoint=ep)
 
-    def _record_shed(self, reason: str) -> None:
-        """Count one shed and fire the flight recorder on the burst
-        EDGE (one bundle per burst, not one per shed)."""
-        self.metrics.record_shed(reason)
+    def _record_shed(self, reason: str,
+                     entry: Optional[ServedModel] = None) -> None:
+        """Count one shed on the request's model and fire the flight
+        recorder on the burst EDGE (one bundle per burst, not one per
+        shed)."""
+        (entry or self.catalog.default).metrics.record_shed(reason)
         if self._shed_burst.note() and self.flight is not None:
             self.flight.trigger("shed_burst", reason=reason)
 
@@ -1460,20 +2104,19 @@ class ModelServer:
         }
 
     def _flight_metrics(self, window_seconds: float) -> dict:
-        return self.metrics.snapshot(
-            self._query_compiles(),
-            checkpoint=self._checkpoint_stats(),
-            index_staleness=self._index_staleness(),
-        )
+        return self._metrics_doc()
 
     # -- warmup / compile accounting ----------------------------------
 
-    def _checkpoint_stats(self) -> dict:
+    def _checkpoint_stats(
+        self, entry: Optional[ServedModel] = None
+    ) -> dict:
         """Checkpoint telemetry of the served engine (ISSUE 5): a model
         served straight out of a training process reports its snapshot
         pipeline; a freshly-loaded model reports Nones. Never raises —
         /metrics must stay up regardless."""
-        eng = getattr(self.model, "engine", None)
+        model = (entry or self.catalog.default).model
+        eng = getattr(model, "engine", None)
         stats = getattr(eng, "checkpoint_stats", None)
         if stats is None:
             return {}
@@ -1482,12 +2125,18 @@ class ModelServer:
         except Exception:
             return {}
 
-    def _query_compiles(self) -> int:
-        """Total query-op shapes compiled across the model's engines
+    def _query_compiles(
+        self, entry: Optional[ServedModel] = None
+    ) -> int:
+        """Total query-op shapes compiled across one model's engines
         (the training engine plus FastText's lazily-built composed query
-        engine, when it exists)."""
-        engines = [getattr(self.model, "engine", None)]
-        qeng = getattr(self.model, "_qeng", None)
+        engine, when it exists). Per-engine first-seen counts: a shape
+        another model already built still counts here (that is the
+        warmed-family contract each model asserts individually);
+        process-level build counts live on the catalog snapshot."""
+        model = (entry or self.catalog.default).model
+        engines = [getattr(model, "engine", None)]
+        qeng = getattr(model, "_qeng", None)
         if qeng is not None:
             engines.append(qeng)
         return sum(
@@ -1530,10 +2179,10 @@ class ModelServer:
 
     # -- request dispatch ---------------------------------------------
 
-    def _dispatch(self, path: str, req: dict):
+    def _dispatch(self, path: str, req: dict, model=None):
         if path != "/shutdown":
             faults.fire("serving.dispatch")
-        m = self.model
+        m = model if model is not None else self.model
         if path == "/analogy":
             return [
                 [w, float(s)]
@@ -1580,8 +2229,9 @@ class ModelServer:
         self._thread.start()
 
     def stop(self) -> None:
-        if self.watcher is not None:
-            self.watcher.stop()
+        for e in list(self.catalog.entries.values()):
+            if e.watcher is not None:
+                e.watcher.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._prev_switch is not None:
@@ -1612,6 +2262,9 @@ def serve_model_dir(
     port_file: Optional[str] = None,
     trace_log: Optional[str] = None,
     flight_dir: Optional[str] = None,
+    models: Optional[dict] = None,
+    model_memory_budget=None,
+    watch_models: Optional[str] = None,
 ) -> None:
     """Load a saved model (any family) and serve it until killed.
 
@@ -1625,7 +2278,15 @@ def serve_model_dir(
     process-wide event recorder with a size-rotated JSONL sink (the
     per-replica half of distributed request tracing: ``cli
     trace-merge`` stitches these across processes); ``flight_dir``
-    arms the anomaly flight recorder."""
+    arms the anomaly flight recorder.
+
+    Multi-model (ISSUE 20): ``models`` maps extra model ids to model
+    dirs served from this same process; ``model_memory_budget``
+    ("512mb", "2gb", or bytes) bounds their combined device residency
+    with LRU stage-out; ``watch_models`` names a catalog root whose
+    ``<id>/LATEST.json`` subdirectories each get their own model +
+    per-model SnapshotWatcher (one trainer's publish rolls only its
+    model)."""
     from glint_word2vec_tpu import load_model
 
     if trace_log:
@@ -1702,10 +2363,57 @@ def serve_model_dir(
     )
     if flight_dir:
         server.enable_flight_recorder(flight_dir)
+    if model_memory_budget is not None:
+        server.catalog.budget_bytes = parse_memory_budget(
+            model_memory_budget
+        )
+    # Stamp the default model's snapshot source so the LRU could stage
+    # it back in were it ever unpinned (it is pinned by default).
+    server.catalog.default.source_dir = model_dir
+    for mid in sorted(models or {}):
+        server.add_model(mid, model_dir=(models or {})[mid])
     if watch_dir is not None:
         server.watch(watch_dir, poll_seconds=watch_poll, current=current)
     elif current is not None:
         server.metrics.generation = current
+    if watch_models:
+        from glint_word2vec_tpu.streaming.publish import (
+            discover_model_publish_dirs,
+            resolve_latest as _resolve_latest,
+        )
+
+        for mid, pub in sorted(
+            discover_model_publish_dirs(watch_models).items()
+        ):
+            if mid == DEFAULT_MODEL_ID:
+                w_mid = None
+            elif mid in server.catalog.entries:
+                w_mid = mid
+            else:
+                gen_dir = _resolve_latest(pub)
+                if gen_dir is None:
+                    logger.info(
+                        "watch-models: %r has a pointer but no "
+                        "committed generation — skipped", mid,
+                    )
+                    continue
+                server.add_model(mid, model_dir=gen_dir)
+                w_mid = mid
+            entry = server._entry(w_mid)
+            if entry.watcher is not None:
+                continue  # --watch-checkpoint already covers it
+            # Seed the watcher with the generation already loaded so
+            # its first poll doesn't redundantly re-stage it.
+            cur = None
+            src = entry.source_dir
+            if src is not None and os.path.dirname(
+                os.path.abspath(src)
+            ) == os.path.abspath(pub):
+                cur = os.path.basename(os.path.normpath(src))
+            server.watch(
+                pub, poll_seconds=watch_poll, current=cur,
+                model_id=w_mid,
+            )
     if port_file:
         from glint_word2vec_tpu.utils import atomic_write_json
 

@@ -113,6 +113,29 @@ def resolve_latest(publish_dir: str) -> Optional[str]:
     return gen_dir
 
 
+def discover_model_publish_dirs(root: str) -> "dict":
+    """Map model-id -> publish dir under a catalog root (ISSUE 20).
+
+    Layout: each immediate subdirectory of ``root`` that carries a
+    ``LATEST.json`` pointer is one model's publish directory, and the
+    subdirectory name is the model id — so a streaming trainer per
+    model publishes independently and the multi-model server (or the
+    fleet's per-model rollout coordinators) watches the whole catalog
+    from one ``--watch-models`` root. Subdirectories without a pointer
+    (a trainer that has not committed its first generation yet) are
+    skipped; a later discovery pass picks them up."""
+    out: dict = {}
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for name in names:
+        d = os.path.join(root, name)
+        if os.path.isfile(os.path.join(d, LATEST_NAME)):
+            out[name] = d
+    return out
+
+
 def next_generation_seq(publish_dir: str) -> int:
     """1 + the highest committed generation number on disk (orphaned
     post-crash generations included, so a restarted trainer never

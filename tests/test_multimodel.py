@@ -1,0 +1,483 @@
+"""Multi-model serving (ISSUE 20): one server hosting N models behind
+one port.
+
+The models are CRAFTED one-hot tables (the test_hotswap discipline) so
+every answer is attributable to exactly one model: models "a" (the
+default) and "b" share the SAME vocabulary but carry different
+vectors — the top-1 synonym of "q" names the model that answered, so a
+cross-model cache hit or a routing mix-up is directly visible in the
+response body. Covered here: path-prefix + header routing with
+default-model back-compat, per-model result-cache isolation,
+shape-keyed program sharing (a same-shape model load builds ZERO new
+XLA programs), the device-memory LRU lifecycle (eviction order, pin
+immunity, budget accounting, concurrent requests during stage-in),
+per-model /reload isolation, and the merged fleet exposition
+(merge_serving_snapshots folding + both Prometheus renderers).
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu import Word2Vec, load_model
+from glint_word2vec_tpu.parallel.engine import (
+    EmbeddingEngine,
+    query_program_builds,
+)
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.serving import (
+    DEFAULT_MODEL_ID,
+    ModelServer,
+    parse_memory_budget,
+    split_model_path,
+)
+from glint_word2vec_tpu.streaming.publish import (
+    LATEST_NAME,
+    SnapshotPublisher,
+)
+from glint_word2vec_tpu.utils import atomic_write_json
+
+WORDS = ["q", "a1", "a2", "b2", "f1", "f2", "f3", "f4"]
+DIM = 16
+
+
+def _e(i, dim=DIM):
+    v = np.zeros(dim, np.float32)
+    v[i] = 1.0
+    return v
+
+
+class _Vocab:
+    def __init__(self, words):
+        self.words = list(words)
+
+
+def _publish_crafted(pub, generations, words=WORDS, dim=DIM):
+    """Write each {row-index: vector} table as one committed generation
+    in ``pub``; returns the generation dir paths in publish order."""
+    counts = np.arange(len(words), 0, -1, dtype=np.int64) * 10
+    eng = EmbeddingEngine(
+        make_mesh(1, 1), len(words), dim, counts, num_negatives=2,
+        seed=7, extra_rows=4,
+    )
+    params = Word2Vec(vector_size=dim).params
+    publisher = SnapshotPublisher(pub, eng, params, keep=4)
+    zeros = np.zeros((eng.num_rows, dim), np.float32)
+    dirs = []
+    for i, rows in enumerate(generations):
+        t = np.zeros((eng.num_rows, dim), np.float32)
+        for idx, vec in rows.items():
+            t[idx] = vec
+        eng.set_tables(t, zeros)
+        publisher.publish(_Vocab(words))
+        eng.wait_pending_saves()
+        dirs.append(os.path.join(pub, f"gen-{i + 1:06d}"))
+    eng.destroy()
+    return dirs
+
+
+#: row indices: q=0, a1=1, a2=2, b2=3, f1=4..f4=7. Filler rows get
+#: axes far from every signal axis so they never crack top-1.
+_FILLERS = {4: _e(10), 5: _e(11), 6: _e(12), 7: _e(13)}
+
+#: model -> the only legal top-1 synonym of "q" there.
+TOP1 = {"default": "a1", "b": "a2", "b@gen2": "b2", "d": "f1"}
+
+
+@pytest.fixture(scope="module")
+def multi(tmp_path_factory):
+    """One server: crafted default model "a" + same-shape models "b"
+    and "d" (distinct vectors), each backed by a committed publish
+    generation it can stage back in from."""
+    root = tmp_path_factory.mktemp("catalog")
+    (a_dir,) = _publish_crafted(
+        str(root / "a"),
+        [{**_FILLERS, 0: _e(1), 1: _e(1), 2: _e(3), 3: _e(4)}],
+    )
+    b_dirs = _publish_crafted(
+        str(root / "b"),
+        [
+            {**_FILLERS, 0: _e(2), 1: _e(6), 2: _e(2), 3: _e(7)},
+            {**_FILLERS, 0: _e(5), 1: _e(8), 2: _e(9), 3: _e(5)},
+        ],
+    )
+    (d_dir,) = _publish_crafted(
+        str(root / "d"),
+        [{**_FILLERS, 0: _e(3), 1: _e(6), 2: _e(7), 3: _e(8), 4: _e(3)}],
+    )
+    # Rewind b's pointer to gen1: the reload-isolation test flips it
+    # forward explicitly.
+    atomic_write_json(
+        os.path.join(str(root / "b"), LATEST_NAME),
+        {"generation": "gen-000001", "seq": 1},
+    )
+    server = ModelServer(load_model(a_dir), port=0, max_batch=8)
+    server.catalog.default.source_dir = a_dir
+    server.start_background()
+    server.add_model("b", model_dir=b_dirs[0])
+    server.add_model("d", model_dir=d_dir)
+    yield server, {"a": a_dir, "b": b_dirs, "d": d_dir}
+    server.stop()
+
+
+def _post(server, path, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(server, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}{path}", timeout=timeout
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _top1(server, path_or_headers):
+    if isinstance(path_or_headers, str):
+        status, body = _post(
+            server, path_or_headers, {"word": "q", "num": 2}
+        )
+    else:
+        status, body = _post(
+            server, "/synonyms", {"word": "q", "num": 2},
+            headers=path_or_headers,
+        )
+    assert status == 200, body
+    return body[0][0]
+
+
+def _restore(server, entries):
+    """Test-exit cleanup: unbounded budget, every entry staged back."""
+    server.catalog.budget_bytes = None
+    for e in entries:
+        server.catalog.ensure_resident(e)
+
+
+# -- routing ----------------------------------------------------------
+
+
+def test_split_model_path_contract():
+    assert split_model_path("/synonyms") == (None, "/synonyms")
+    assert split_model_path("/m/b/synonyms") == ("b", "/synonyms")
+    assert split_model_path("/m/b") == ("b", "/")
+    # The path prefix wins over the header.
+    assert split_model_path("/m/b/vector", "c") == ("b", "/vector")
+    assert split_model_path("/vector", "c") == ("c", "/vector")
+
+
+def test_parse_memory_budget():
+    assert parse_memory_budget(None) is None
+    assert parse_memory_budget(0) is None
+    assert parse_memory_budget("4096") == 4096
+    assert parse_memory_budget("2kb") == 2048
+    assert parse_memory_budget("1mb") == 1 << 20
+    with pytest.raises(ValueError):
+        parse_memory_budget("lots")
+
+
+def test_routing_path_header_and_default(multi):
+    server, _ = multi
+    assert _top1(server, "/synonyms") == TOP1["default"]
+    assert _top1(server, "/m/b/synonyms") == TOP1["b"]
+    assert _top1(server, "/m/d/synonyms") == TOP1["d"]
+    assert _top1(server, {"X-Glint-Model": "b"}) == TOP1["b"]
+    # Explicit default id routes to the same entry as the bare path.
+    assert (
+        _top1(server, f"/m/{DEFAULT_MODEL_ID}/synonyms")
+        == TOP1["default"]
+    )
+    status, body = _post(
+        server, "/m/nope/synonyms", {"word": "q", "num": 2}
+    )
+    assert status == 404 and "nope" in body["error"]
+    status, body = _get(server, "/m/nope/healthz")
+    assert status == 404
+    status, doc = _get(server, "/models")
+    assert status == 200
+    assert set(doc["models"]) >= {DEFAULT_MODEL_ID, "b", "d"}
+    assert doc["default"] == DEFAULT_MODEL_ID
+
+
+def test_per_model_healthz_and_metrics(multi):
+    server, _ = multi
+    status, h = _get(server, "/m/b/healthz")
+    assert status == 200 and h["model"] == "b" and h["resident"]
+    status, m = _get(server, "/m/b/metrics")
+    assert status == 200 and m["model_id"] == "b"
+    assert m["resident_replicas"] == 1
+    status, top = _get(server, "/metrics")
+    assert status == 200
+    assert set(top["models"]) >= {DEFAULT_MODEL_ID, "b", "d"}
+    assert top["catalog"]["models"] >= 3
+
+
+# -- satellite: per-model result cache ---------------------------------
+
+
+def test_cross_model_cache_isolation(multi):
+    # Two models sharing vocab words but different vectors: the same
+    # (word, num) query must answer from each model's OWN cache. A
+    # shared cache would leak model a's top-1 into model b's answer.
+    server, _ = multi
+    for _ in range(3):  # repeats are cache hits past the first
+        assert _top1(server, "/synonyms") == TOP1["default"]
+        assert _top1(server, "/m/b/synonyms") == TOP1["b"]
+    _, mb = _get(server, "/m/b/metrics")
+    _, ma = _get(server, "/metrics")
+    assert mb["synonym_cache"]["hits"] >= 2
+    assert ma["synonym_cache"]["hits"] >= 2
+
+
+# -- tentpole: shape-keyed program sharing -----------------------------
+
+
+def test_same_shape_model_load_builds_zero_programs(multi):
+    server, dirs = multi
+    n0 = query_program_builds()
+    entry = server.add_model("zero-build", model_dir=dirs["a"])
+    assert query_program_builds() == n0, (
+        "same-(V, d) model load must reuse every warmed program"
+    )
+    _, summary = _get(server, "/models")
+    assert summary["models"]["zero-build"]["post_warmup_compiles"] == 0
+    assert _top1(server, "/m/zero-build/synonyms") == TOP1["default"]
+    assert entry.model.engine.shared_program_hits > 0
+    # The sharing is shape-KEYED, not unconditional: an odd-shape
+    # model (different vocab rows and dim) does build new programs.
+    odd_root = os.path.join(os.path.dirname(dirs["a"]), "..", "odd")
+    (odd_dir,) = _publish_crafted(
+        os.path.abspath(odd_root),
+        [{0: _e(1, 24), 1: _e(1, 24), 2: _e(3, 24)}],
+        words=["q", "a1", "a2", "x1", "x2", "x3"], dim=24,
+    )
+    n1 = query_program_builds()
+    server.add_model("odd", model_dir=odd_dir)
+    assert query_program_builds() > n1
+
+
+# -- satellite: LRU lifecycle ------------------------------------------
+
+
+def test_lru_eviction_order(multi):
+    server, _ = multi
+    cat = server.catalog
+    b, d = cat.get("b"), cat.get("d")
+    try:
+        for e in [b, d]:
+            cat.ensure_resident(e)
+        cat.touch(d)  # least recently used from here on
+        # Every other evictable entry is touched AFTER d, so the LRU
+        # choice between them is deterministic regardless of which
+        # models earlier tests installed.
+        for e in list(cat.entries.values()):
+            if e not in (b, d) and e.resident:
+                cat.touch(e)
+        cat.touch(b)  # most recently used
+        cat.budget_bytes = cat.resident_bytes() - 1
+        cat.enforce_budget()
+        assert not d.resident, "LRU entry must be staged out first"
+        assert b.resident
+        assert cat.default.resident
+    finally:
+        _restore(server, [b, d])
+
+
+def test_pinned_models_are_never_evicted(multi):
+    server, _ = multi
+    cat = server.catalog
+    b, d = cat.get("b"), cat.get("d")
+    try:
+        for e in [b, d]:
+            cat.ensure_resident(e)
+        status, resp = _post(
+            server, "/models/pin", {"model": "b", "pinned": True}
+        )
+        assert status == 200 and resp["pins"] == 1
+        cat.budget_bytes = 1  # nothing fits: evict all unpinned
+        cat.enforce_budget()
+        assert b.resident, "pinned model staged out"
+        assert cat.default.resident, "default model staged out"
+        assert not d.resident
+        # Direct eviction of a pinned entry must refuse too.
+        assert cat.evict(b) is False
+    finally:
+        _post(server, "/models/pin", {"model": "b", "pinned": False})
+        _restore(server, [b, d])
+    assert b.pins == 0
+
+
+def test_budget_accounting_across_stage_out_and_in(multi):
+    server, _ = multi
+    cat = server.catalog
+    d = cat.get("d")
+    try:
+        cat.ensure_resident(d)
+        total0 = cat.resident_bytes()
+        d_bytes = d.resident_bytes()
+        assert d_bytes > 0
+        snap0 = cat.snapshot()
+        assert cat.evict(d) is True
+        assert not d.resident
+        assert d.resident_bytes() == 0
+        assert d.cost_bytes == d_bytes  # remembered for planning
+        assert cat.resident_bytes() == total0 - d_bytes
+        cat.ensure_resident(d)
+        assert d.resident
+        assert cat.resident_bytes() == total0
+        snap1 = cat.snapshot()
+        assert snap1["evictions_total"] == snap0["evictions_total"] + 1
+        assert snap1["stage_ins_total"] == snap0["stage_ins_total"] + 1
+        assert snap1["cold_hits_total"] >= snap0["cold_hits_total"] + 1
+        assert (
+            snap1["stage_in_seconds_total"]
+            >= snap0["stage_in_seconds_total"]
+        )
+    finally:
+        _restore(server, [d])
+
+
+def test_concurrent_requests_during_stage_in_all_answered(multi):
+    # Requests racing a cold model's stage-in must ALL be answered 200
+    # from the newly resident tables (never a 5xx), through exactly one
+    # stage-in.
+    server, _ = multi
+    cat = server.catalog
+    d = cat.get("d")
+    try:
+        cat.ensure_resident(d)
+        assert cat.evict(d) is True
+        stage_ins0 = d.stage_ins
+        results = [None] * 8
+
+        def hit(i):
+            results[i] = _post(
+                server, "/m/d/synonyms", {"word": "q", "num": 2}
+            )
+
+        threads = [
+            threading.Thread(target=hit, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for status, body in results:
+            assert status == 200, body
+            assert body[0][0] == TOP1["d"]
+        assert d.stage_ins == stage_ins0 + 1
+        assert d.resident
+    finally:
+        _restore(server, [d])
+
+
+# -- per-model reload isolation ----------------------------------------
+
+
+def test_per_model_reload_leaves_other_models_untouched(multi):
+    server, dirs = multi
+    _, before = _get(server, "/metrics")
+    default_swaps0 = before["hot_swap"]["table_swaps_total"]
+    status, resp = _post(
+        server, "/m/b/reload",
+        {"dir": dirs["b"][1], "generation": "gen-000002"},
+    )
+    assert status == 200, resp
+    assert resp["model"] == "b"
+    assert _top1(server, "/m/b/synonyms") == TOP1["b@gen2"]
+    # The default model still answers from ITS tables, and its swap
+    # counters never moved — the rollout touched exactly one model.
+    assert _top1(server, "/synonyms") == TOP1["default"]
+    _, after = _get(server, "/metrics")
+    assert after["hot_swap"]["table_swaps_total"] == default_swaps0
+    assert (
+        after["models"]["b"]["hot_swap"]["table_swaps_total"] >= 1
+    )
+    assert after["models"]["b"]["hot_swap"]["generation"] == "gen-000002"
+    # The entry's stage-in source follows the promoted generation.
+    assert server.catalog.get("b").source_dir == dirs["b"][1]
+
+
+# -- satellite: merged exposition --------------------------------------
+
+
+def test_merge_serving_snapshots_folds_models_and_catalog(multi):
+    from glint_word2vec_tpu.obs.aggregate import merge_serving_snapshots
+
+    server, _ = multi
+    _top1(server, "/m/b/synonyms")
+    _, snap = _get(server, "/metrics")
+    merged = merge_serving_snapshots([snap, snap])
+    assert merged["replicas"] == 2
+    b = merged["models"]["b"]
+    assert b["model_id"] == "b"
+    assert b["resident_replicas"] == 2 and b["resident"]
+    ep = b["endpoints"]["/synonyms"]
+    assert ep["count"] == 2 * snap["models"]["b"]["endpoints"][
+        "/synonyms"]["count"]
+    cat = merged["catalog"]
+    assert cat["replicas"] == 2
+    assert cat["models"] == snap["catalog"]["models"]
+    assert (
+        cat["stage_ins_total"]
+        == 2 * snap["catalog"]["stage_ins_total"]
+    )
+    assert (
+        cat["query_program_builds"]
+        == 2 * snap["catalog"]["query_program_builds"]
+    )
+
+
+def test_prometheus_renderers_carry_model_families(multi):
+    from glint_word2vec_tpu.obs.aggregate import merge_serving_snapshots
+    from glint_word2vec_tpu.obs.prometheus import (
+        gang_to_prometheus,
+        serving_to_prometheus,
+    )
+
+    server, _ = multi
+    _, snap = _get(server, "/metrics")
+    text = serving_to_prometheus(snap)
+    for family in (
+        "glint_model_requests_total", "glint_model_cache_hits_total",
+        "glint_model_post_warmup_compiles",
+        "glint_model_resident_replicas", "glint_model_pinned",
+        "glint_catalog_models", "glint_catalog_resident_bytes",
+        "glint_catalog_query_program_builds_total",
+        "glint_catalog_shared_program_hits_total",
+    ):
+        assert f"# TYPE {family}" in text, family
+    assert 'glint_model_requests_total{model="b",path="/synonyms"}' \
+        in text
+    # The single-model exposition stays byte-compatible: no model or
+    # catalog families without a catalog in the snapshot.
+    bare = dict(snap)
+    bare.pop("models"), bare.pop("catalog")
+    assert "glint_model_" not in serving_to_prometheus(bare)
+    merged = merge_serving_snapshots([snap, snap])
+    gang = gang_to_prometheus({"state": "serving", "serving": merged})
+    assert "glint_gang_model_resident_replicas" in gang
+    assert 'glint_gang_model_generation_info{model="b"' in gang
+    # The HTTP prometheus view renders the same families end-to-end.
+    with urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/metrics?format=prometheus",
+        timeout=30,
+    ) as r:
+        live = r.read().decode()
+    assert "glint_model_requests_total" in live
+    assert "glint_catalog_models" in live
